@@ -1,0 +1,16 @@
+# Cluster registration object only — bare-metal clusters own no cloud
+# resources. Reference analog: bare-metal-rancher-k8s/main.tf:1 (the
+# data.external rancher_cluster REST hack, gcp-rancher-k8s/files/
+# rancher_cluster.sh:6,18-101).
+
+data "external" "register_cluster" {
+  program = ["sh", "${path.module}/../files/register_cluster.sh"]
+  query = {
+    api_url          = var.api_url
+    access_key       = var.access_key
+    secret_key       = var.secret_key
+    name             = var.name
+    k8s_version      = var.k8s_version
+    network_provider = var.k8s_network_provider
+  }
+}

@@ -1,0 +1,34 @@
+variable "name" {
+  description = "Cluster name"
+}
+
+variable "api_url" {
+  description = "Manager API url (from module.cluster-manager)"
+}
+
+variable "access_key" {}
+
+variable "secret_key" {
+  sensitive = true
+}
+
+variable "k8s_version" {
+  default = "v1.31.1"
+}
+
+variable "k8s_network_provider" {
+  default = "calico"
+}
+
+variable "private_registry" {
+  default = ""
+}
+
+variable "private_registry_username" {
+  default = ""
+}
+
+variable "private_registry_password" {
+  default   = ""
+  sensitive = true
+}

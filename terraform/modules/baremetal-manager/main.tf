@@ -1,0 +1,44 @@
+# Cluster-manager control plane on an existing host over SSH.
+# Reference analog: bare-metal-rancher/main.tf:21-103 (pure null_resource +
+# remote-exec; no cloud resources).
+
+locals {
+  install_script = templatefile("${path.module}/../files/install_manager.sh.tpl", {
+    admin_password = var.admin_password
+    manager_name   = var.name
+  })
+}
+
+resource "null_resource" "install_manager" {
+  triggers = {
+    host = var.host
+  }
+
+  connection {
+    type        = "ssh"
+    host        = var.host
+    user        = var.ssh_user
+    private_key = file(pathexpand(var.key_path))
+    bastion_host = var.bastion_host != "" ? var.bastion_host : null
+  }
+
+  provisioner "remote-exec" {
+    inline = [local.install_script]
+  }
+}
+
+# API credentials minted on the host by install_manager.sh.tpl.
+# Reference analog: the matti/outputs/shell ssh-scrape of ~/rancher_api_key
+# (gcp-rancher/main.tf:146-163) — same shape, but the token is a first-class
+# ServiceAccount token instead of a UI-minted key.
+data "external" "api_key" {
+  depends_on = [null_resource.install_manager]
+  program = ["sh", "-c", <<-EOT
+    ssh -o StrictHostKeyChecking=no -i ${pathexpand(var.key_path)} \
+      ${var.ssh_user}@${var.host} \
+      'printf "{\"access_key\": \"%s\", \"secret_key\": \"%s\"}" \
+        "$(cat ~/.tpu-kubernetes/api_access_key)" \
+        "$(cat ~/.tpu-kubernetes/api_secret_key)"'
+  EOT
+  ]
+}

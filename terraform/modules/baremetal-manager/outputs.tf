@@ -1,0 +1,15 @@
+# The manager output contract consumed by every cluster module as
+# ${module.cluster-manager.*} (SURVEY §2.3; reference: gcp-rancher/outputs.tf:1-9).
+
+output "api_url" {
+  value = "https://${var.host}:6443"
+}
+
+output "access_key" {
+  value = data.external.api_key.result.access_key
+}
+
+output "secret_key" {
+  value     = data.external.api_key.result.secret_key
+  sensitive = true
+}

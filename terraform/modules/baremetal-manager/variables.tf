@@ -1,0 +1,48 @@
+variable "name" {
+  description = "Cluster manager name"
+}
+
+variable "admin_password" {
+  description = "Control plane admin password"
+  sensitive   = true
+}
+
+variable "server_image" {
+  description = "Override control-plane server image (empty = default)"
+  default     = ""
+}
+
+variable "agent_image" {
+  description = "Override node agent image (empty = default)"
+  default     = ""
+}
+
+variable "host" {
+  description = "Existing host (IP or DNS) to install the manager on"
+}
+
+variable "ssh_user" {
+  default = "root"
+}
+
+variable "key_path" {
+  description = "SSH private key path"
+  default     = "~/.ssh/id_rsa"
+}
+
+variable "bastion_host" {
+  default = ""
+}
+
+variable "private_registry" {
+  default = ""
+}
+
+variable "private_registry_username" {
+  default = ""
+}
+
+variable "private_registry_password" {
+  default   = ""
+  sensitive = true
+}

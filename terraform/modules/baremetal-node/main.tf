@@ -1,0 +1,32 @@
+# One node on an existing host over SSH (+ optional bastion).
+# Reference analog: bare-metal-rancher-k8s-host/main.tf:25-43.
+
+locals {
+  agent_script = templatefile("${path.module}/../files/install_node_agent.sh.tpl", {
+    api_url            = var.api_url
+    registration_token = var.registration_token
+    ca_checksum        = var.ca_checksum
+    node_role          = var.node_role
+    hostname           = var.hostname
+    extra_labels       = ""
+  })
+}
+
+resource "null_resource" "install_node_agent" {
+  triggers = {
+    host = var.host
+    role = var.node_role
+  }
+
+  connection {
+    type         = "ssh"
+    host         = var.host
+    user         = var.ssh_user
+    private_key  = file(pathexpand(var.key_path))
+    bastion_host = var.bastion_host != "" ? var.bastion_host : null
+  }
+
+  provisioner "remote-exec" {
+    inline = [local.agent_script]
+  }
+}

@@ -1,0 +1,51 @@
+variable "host" {
+  description = "Existing host (IP or DNS) to join as a node"
+}
+
+variable "hostname" {
+  description = "Hostname to assign"
+}
+
+variable "api_url" {}
+
+variable "access_key" {}
+
+variable "secret_key" {
+  sensitive = true
+}
+
+variable "registration_token" {
+  sensitive = true
+}
+
+variable "ca_checksum" {}
+
+variable "node_role" {
+  description = "worker | etcd | control (reference: create/node.go:223-261)"
+  default     = "worker"
+}
+
+variable "ssh_user" {
+  default = "root"
+}
+
+variable "key_path" {
+  default = "~/.ssh/id_rsa"
+}
+
+variable "bastion_host" {
+  default = ""
+}
+
+variable "private_registry" {
+  default = ""
+}
+
+variable "private_registry_username" {
+  default = ""
+}
+
+variable "private_registry_password" {
+  default   = ""
+  sensitive = true
+}

@@ -1,0 +1,67 @@
+#!/bin/sh
+# Bootstrap the cluster-manager control plane on a fresh host.
+#
+# TPU-native redesign of the reference's three-script chain
+# (install_docker_rancher.sh.tpl + install_rancher_master.sh.tpl +
+# setup_rancher.sh.tpl, reference: terraform/modules/files/*): instead of
+# docker + rancher/server (minutes of image pulls), a single k3s server
+# install — the control plane the clusters register with. Much faster boot,
+# which matters for the create→first-train-step target (<15 min).
+set -eu
+
+ADMIN_PASSWORD="${admin_password}"
+MANAGER_NAME="${manager_name}"
+
+# 1. install k3s server (pinned channel for reproducibility)
+if ! command -v k3s >/dev/null 2>&1; then
+  curl -sfL https://get.k3s.io | INSTALL_K3S_CHANNEL=v1.31 sh -s - server \
+    --cluster-init \
+    --node-label tpu-kubernetes/role=manager
+fi
+
+# 2. wait for the API to come up (reference analog:
+#    install_rancher_master.sh.tpl:4-15 spin-wait)
+i=0
+until k3s kubectl get --raw /readyz >/dev/null 2>&1; do
+  i=$((i+1)); [ $i -gt 120 ] && { echo "k3s API never became ready" >&2; exit 1; }
+  sleep 2
+done
+
+# 3. install the fleet registry (cluster inventory lives in the manager's own
+#    kube API as ConfigMaps under this namespace — the Rancher-analog store)
+k3s kubectl create namespace tpu-fleet --dry-run=client -o yaml | k3s kubectl apply -f -
+
+# 4. mint API credentials: a long-lived ServiceAccount token with rights over
+#    the fleet namespace (replaces the reference's ssh-scrape hack,
+#    reference: gcp-rancher/main.tf:149-163)
+k3s kubectl -n tpu-fleet create serviceaccount fleet-admin \
+  --dry-run=client -o yaml | k3s kubectl apply -f -
+k3s kubectl create clusterrolebinding fleet-admin \
+  --clusterrole=cluster-admin --serviceaccount=tpu-fleet:fleet-admin \
+  --dry-run=client -o yaml | k3s kubectl apply -f -
+cat <<EOF | k3s kubectl apply -f -
+apiVersion: v1
+kind: Secret
+metadata:
+  name: fleet-admin-token
+  namespace: tpu-fleet
+  annotations:
+    kubernetes.io/service-account.name: fleet-admin
+type: kubernetes.io/service-account-token
+EOF
+
+i=0
+until [ -n "$(k3s kubectl -n tpu-fleet get secret fleet-admin-token -o jsonpath='{.data.token}' 2>/dev/null)" ]; do
+  i=$((i+1)); [ $i -gt 60 ] && { echo "token never provisioned" >&2; exit 1; }
+  sleep 1
+done
+
+# 5. drop credentials where the api-key output can read them
+#    (reference analog: setup_rancher.sh.tpl writes ~/rancher_api_key)
+mkdir -p "$HOME/.tpu-kubernetes"
+k3s kubectl -n tpu-fleet get secret fleet-admin-token -o jsonpath='{.data.token}' \
+  | base64 -d > "$HOME/.tpu-kubernetes/api_secret_key"
+echo "fleet-admin" > "$HOME/.tpu-kubernetes/api_access_key"
+chmod 600 "$HOME/.tpu-kubernetes/api_secret_key"
+
+echo "manager '$MANAGER_NAME' ready"

@@ -1,0 +1,52 @@
+#!/bin/sh
+# Join one node to its cluster's control plane.
+#
+# Reference analog: install_rancher_agent.sh.tpl (reference:
+# gcp-rancher-k8s-host/files/install_rancher_agent.sh.tpl:1-44) — install
+# docker, set hostname, mount optional disk, then run the rancher/agent
+# container with --server/--token/--ca-checksum and the role flag.
+#
+# Ours joins via k3s: control/etcd roles run `k3s server` joining the HA
+# control plane; workers run `k3s agent`. The (api_url, registration_token,
+# ca_checksum) trio is the same contract (SURVEY §5.8).
+set -eu
+
+API_URL="${api_url}"
+TOKEN="${registration_token}"
+CA_CHECKSUM="${ca_checksum}"
+ROLE="${node_role}"          # worker | etcd | control
+HOSTNAME_OVERRIDE="${hostname}"
+EXTRA_LABELS="${extra_labels}"  # comma-separated k=v, may be empty
+
+hostnamectl set-hostname "$HOSTNAME_OVERRIDE" 2>/dev/null || \
+  hostname "$HOSTNAME_OVERRIDE" || true
+
+# verify the control plane CA before joining (reference pins --ca-checksum)
+actual=$(curl -ks "$API_URL/cacerts" | sha256sum | cut -d' ' -f1)
+if [ -n "$CA_CHECKSUM" ] && [ "$actual" != "$CA_CHECKSUM" ]; then
+  echo "CA checksum mismatch: expected $CA_CHECKSUM got $actual" >&2
+  exit 1
+fi
+
+labels="--node-label tpu-kubernetes/role=$ROLE"
+if [ -n "$EXTRA_LABELS" ]; then
+  for kv in $(echo "$EXTRA_LABELS" | tr ',' ' '); do
+    labels="$labels --node-label $kv"
+  done
+fi
+
+case "$ROLE" in
+  control|etcd)
+    # reference maps control→controlplane (gcp-rancher-k8s-host/main.tf:22);
+    # in k3s both roles join the server quorum
+    curl -sfL https://get.k3s.io | INSTALL_K3S_CHANNEL=v1.31 sh -s - server \
+      --server "$API_URL" --token "$TOKEN" $labels
+    ;;
+  worker)
+    curl -sfL https://get.k3s.io | INSTALL_K3S_CHANNEL=v1.31 sh -s - agent \
+      --server "$API_URL" --token "$TOKEN" $labels
+    ;;
+  *)
+    echo "unknown role $ROLE" >&2; exit 1
+    ;;
+esac

@@ -1,0 +1,71 @@
+#!/bin/sh
+# Bootstrap one host of a TPU pod slice: join the cluster control plane AND
+# wire up jax.distributed for the whole slice.
+#
+# This is the TPU-native replacement for the reference's rancher-agent image
+# (nvidia-docker + CUDA + NCCL in the north-star framing): the TPU VM image
+# already carries libtpu + JAX; this script adds (a) cluster membership and
+# (b) the collective-bootstrap env (coordinator address, process count/index,
+# slice topology) — the analog of the agent's --server/--token/--ca-checksum
+# trio (reference: install_rancher_agent.sh.tpl:44), extended with the three
+# facts a JAX process needs to join the slice collective (SURVEY §5.8).
+set -eu
+
+API_URL="${api_url}"
+TOKEN="${registration_token}"
+CA_CHECKSUM="${ca_checksum}"
+SLICE_NAME="${slice_name}"
+ACCELERATOR_TYPE="${accelerator_type}"
+SLICE_TOPOLOGY="${slice_topology}"
+NUM_HOSTS="${num_hosts}"
+COORDINATOR_PORT="${coordinator_port}"
+
+md() { # TPU VM metadata helper
+  curl -s -H 'Metadata-Flavor: Google' \
+    "http://metadata.google.internal/computeMetadata/v1/$1"
+}
+
+# per-host identity comes from the TPU VM metadata the platform stamps on
+# every host of a slice
+WORKER_ID=$(md 'instance/attributes/agent-worker-number' || echo 0)
+WORKER_IPS=$(md 'instance/attributes/worker-network-endpoints' \
+  | tr ',' '\n' | cut -d: -f3 | paste -sd' ' -)
+COORDINATOR_IP=$(echo "$WORKER_IPS" | cut -d' ' -f1)
+
+hostnamectl set-hostname "$SLICE_NAME-host-$WORKER_ID" 2>/dev/null || true
+
+# 1. jax.distributed env for every login shell and the job runtime
+mkdir -p /etc/tpu-kubernetes
+cat > /etc/tpu-kubernetes/jax.env <<EOF
+JAX_COORDINATOR_ADDRESS=$COORDINATOR_IP:$COORDINATOR_PORT
+JAX_NUM_PROCESSES=$NUM_HOSTS
+JAX_PROCESS_ID=$WORKER_ID
+TPU_ACCELERATOR_TYPE=$ACCELERATOR_TYPE
+TPU_SLICE_TOPOLOGY=$SLICE_TOPOLOGY
+TPU_SLICE_NAME=$SLICE_NAME
+EOF
+( set -a; . /etc/tpu-kubernetes/jax.env; set +a
+  env | grep -E '^(JAX_|TPU_)' | sed 's/^/export /' > /etc/profile.d/tpu-kubernetes.sh )
+
+# 2. join the cluster as a worker labeled with the slice identity so JobSet /
+#    gang scheduling can target whole slices
+actual=$(curl -ks "$API_URL/cacerts" | sha256sum | cut -d' ' -f1)
+if [ -n "$CA_CHECKSUM" ] && [ "$actual" != "$CA_CHECKSUM" ]; then
+  echo "CA checksum mismatch" >&2; exit 1
+fi
+curl -sfL https://get.k3s.io | INSTALL_K3S_CHANNEL=v1.31 sh -s - agent \
+  --server "$API_URL" --token "$TOKEN" \
+  --node-label tpu-kubernetes/role=worker \
+  --node-label tpu-kubernetes/accelerator="$ACCELERATOR_TYPE" \
+  --node-label tpu-kubernetes/slice="$SLICE_NAME" \
+  --node-label tpu-kubernetes/slice-host="$WORKER_ID"
+
+# 3. health-gate: verify libtpu sees the local chips before declaring ready
+#    (SURVEY §5.3: TPU-VM readiness gate)
+python3 - <<'EOF' || { echo "TPU devices not visible" >&2; exit 1; }
+import glob, sys
+accel = glob.glob('/dev/accel*') or glob.glob('/dev/vfio/*')
+sys.exit(0 if accel else 1)
+EOF
+
+echo "slice $SLICE_NAME host $WORKER_ID ready"

@@ -1,0 +1,59 @@
+#!/bin/sh
+# Idempotent cluster registration with the manager control plane, used as a
+# terraform external data source by every *-cluster module.
+#
+# Reference analog: rancher_cluster.sh (reference:
+# gcp-rancher-k8s/files/rancher_cluster.sh:6,18-101) — a data source that
+# mutates the control plane via REST, idempotent by name lookup, returning
+# {cluster_id, registration_token, ca_checksum}.
+#
+# Ours talks to the manager's kube API (see install_manager.sh.tpl): one
+# ConfigMap per cluster in the tpu-fleet namespace holds the cluster record;
+# the registration token is minted once and reused on re-apply.
+#
+# stdin (terraform external protocol): {"api_url":…,"access_key":…,
+#   "secret_key":…,"name":…,"k8s_version":…,"network_provider":…}
+# stdout: {"cluster_id":…,"registration_token":…,"ca_checksum":…}
+set -eu
+
+command -v jq >/dev/null 2>&1 || { echo '{"error":"jq is required"}' ; exit 1; }
+
+INPUT=$(cat)
+API_URL=$(echo "$INPUT" | jq -r .api_url)
+SECRET_KEY=$(echo "$INPUT" | jq -r .secret_key)
+NAME=$(echo "$INPUT" | jq -r .name)
+K8S_VERSION=$(echo "$INPUT" | jq -r .k8s_version)
+NETWORK=$(echo "$INPUT" | jq -r .network_provider)
+
+auth="Authorization: Bearer $SECRET_KEY"
+base="$API_URL/api/v1/namespaces/tpu-fleet/configmaps"
+
+# 1. look up by name (idempotency, reference: rancher_cluster.sh:24-27)
+existing=$(curl -ks -H "$auth" "$base/cluster-$NAME" || true)
+if [ "$(echo "$existing" | jq -r '.metadata.name // empty')" = "cluster-$NAME" ]; then
+  echo "$existing" | jq -c '{cluster_id: .data.cluster_id,
+                            registration_token: .data.registration_token,
+                            ca_checksum: .data.ca_checksum}'
+  exit 0
+fi
+
+# 2. create: mint id + registration token; CA checksum comes from the
+#    manager's cluster CA so joining agents can pin it
+cluster_id="c-$(head -c6 /dev/urandom | od -An -tx1 | tr -d ' \n')"
+token="$(head -c24 /dev/urandom | od -An -tx1 | tr -d ' \n')"
+ca_checksum=$(curl -ks "$API_URL/cacerts" | sha256sum | cut -d' ' -f1)
+
+payload=$(jq -cn --arg name "cluster-$NAME" --arg id "$cluster_id" \
+  --arg tok "$token" --arg ca "$ca_checksum" --arg ver "$K8S_VERSION" \
+  --arg net "$NETWORK" \
+  '{apiVersion:"v1", kind:"ConfigMap",
+    metadata:{name:$name, namespace:"tpu-fleet",
+              labels:{"tpu-kubernetes/kind":"cluster"}},
+    data:{cluster_id:$id, registration_token:$tok, ca_checksum:$ca,
+          k8s_version:$ver, network_provider:$net}}')
+
+curl -ksf -X POST -H "$auth" -H 'Content-Type: application/json' \
+  -d "$payload" "$base" >/dev/null
+
+jq -cn --arg id "$cluster_id" --arg tok "$token" --arg ca "$ca_checksum" \
+  '{cluster_id:$id, registration_token:$tok, ca_checksum:$ca}'

@@ -1,0 +1,45 @@
+# GCE cluster envelope: registration + the network the nodes land in.
+# Reference analog: gcp-rancher-k8s/main.tf:1 (data.external rancher_cluster),
+# :23-26 (network), :30-53 (firewall rke_ports: SSH/6443/etcd/kubelet/NodePorts).
+
+provider "google" {
+  credentials = file(var.gcp_path_to_credentials)
+  project     = var.gcp_project_id
+  region      = var.gcp_compute_region
+}
+
+data "external" "register_cluster" {
+  program = ["sh", "${path.module}/../files/register_cluster.sh"]
+  query = {
+    api_url          = var.api_url
+    access_key       = var.access_key
+    secret_key       = var.secret_key
+    name             = var.name
+    k8s_version      = var.k8s_version
+    network_provider = var.k8s_network_provider
+  }
+}
+
+resource "google_compute_network" "cluster" {
+  name                    = "${var.name}-network"
+  auto_create_subnetworks = true
+}
+
+resource "google_compute_firewall" "cluster" {
+  name    = "${var.name}-firewall"
+  network = google_compute_network.cluster.name
+
+  # k8s port matrix (reference: gcp-rancher-k8s/main.tf:30-53 rke_ports)
+  allow {
+    protocol = "tcp"
+    ports    = ["22", "6443", "2379-2380", "10250", "30000-32767"]
+  }
+
+  allow {
+    protocol = "udp"
+    ports    = ["8472"] # flannel/cilium vxlan
+  }
+
+  source_ranges = ["0.0.0.0/0"]
+  target_tags   = ["${var.name}-node"]
+}

@@ -1,0 +1,23 @@
+# Cluster output contract + provider network handles (SURVEY §2.3;
+# reference: gcp-rancher-k8s/outputs.tf:1-19).
+
+output "cluster_id" {
+  value = data.external.register_cluster.result.cluster_id
+}
+
+output "registration_token" {
+  value     = data.external.register_cluster.result.registration_token
+  sensitive = true
+}
+
+output "ca_checksum" {
+  value = data.external.register_cluster.result.ca_checksum
+}
+
+output "gcp_compute_network_name" {
+  value = google_compute_network.cluster.name
+}
+
+output "gcp_compute_firewall_host_tag" {
+  value = "${var.name}-node"
+}

@@ -1,0 +1,70 @@
+# Cluster-manager VM on GCE: network + firewall + instance.
+# Reference analog: gcp-rancher/main.tf:7-59 (google_compute_network/
+# firewall/instance with metadata_startup_script), :92-163 (install/setup +
+# api-key scrape).
+
+provider "google" {
+  credentials = file(var.gcp_path_to_credentials)
+  project     = var.gcp_project_id
+  region      = var.gcp_compute_region
+}
+
+resource "google_compute_network" "manager" {
+  name                    = "${var.name}-manager-network"
+  auto_create_subnetworks = true
+}
+
+resource "google_compute_firewall" "manager" {
+  name    = "${var.name}-manager-firewall"
+  network = google_compute_network.manager.name
+
+  # 22 ssh, 6443 kube API (reference opens 80/443 for the rancher UI,
+  # gcp-rancher/main.tf:14-28; our control plane is the kube API itself)
+  allow {
+    protocol = "tcp"
+    ports    = ["22", "6443"]
+  }
+
+  source_ranges = ["0.0.0.0/0"]
+  target_tags   = ["${var.name}-manager"]
+}
+
+resource "google_compute_instance" "manager" {
+  name         = "${var.name}-manager"
+  machine_type = var.gcp_machine_type
+  zone         = var.gcp_zone
+  tags         = ["${var.name}-manager"]
+
+  boot_disk {
+    initialize_params {
+      image = var.gcp_image
+      size  = 100
+    }
+  }
+
+  network_interface {
+    network = google_compute_network.manager.name
+    access_config {}
+  }
+
+  metadata_startup_script = templatefile(
+    "${path.module}/../files/install_manager.sh.tpl", {
+      admin_password = var.admin_password
+      manager_name   = var.name
+    }
+  )
+}
+
+# API credentials minted on the manager (reference analog: ssh api-key scrape
+# gcp-rancher/main.tf:146-163).
+data "external" "api_key" {
+  depends_on = [google_compute_instance.manager]
+  program = ["sh", "-c", <<-EOT
+    ssh -o StrictHostKeyChecking=no \
+      ${google_compute_instance.manager.network_interface[0].access_config[0].nat_ip} \
+      'printf "{\"access_key\": \"%s\", \"secret_key\": \"%s\"}" \
+        "$(cat ~/.tpu-kubernetes/api_access_key)" \
+        "$(cat ~/.tpu-kubernetes/api_secret_key)"'
+  EOT
+  ]
+}

@@ -1,0 +1,14 @@
+# Manager output contract (SURVEY §2.3; reference: gcp-rancher/outputs.tf:1-9).
+
+output "api_url" {
+  value = "https://${google_compute_instance.manager.network_interface[0].access_config[0].nat_ip}:6443"
+}
+
+output "access_key" {
+  value = data.external.api_key.result.access_key
+}
+
+output "secret_key" {
+  value     = data.external.api_key.result.secret_key
+  sensitive = true
+}

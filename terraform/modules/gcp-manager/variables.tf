@@ -1,0 +1,48 @@
+variable "name" {}
+
+variable "admin_password" {
+  sensitive = true
+}
+
+variable "server_image" {
+  default = ""
+}
+
+variable "agent_image" {
+  default = ""
+}
+
+variable "gcp_path_to_credentials" {
+  description = "Path to a GCP service-account JSON file"
+}
+
+variable "gcp_project_id" {}
+
+variable "gcp_compute_region" {
+  default = "us-central1"
+}
+
+variable "gcp_zone" {
+  default = "us-central1-a"
+}
+
+variable "gcp_machine_type" {
+  default = "n2-standard-4"
+}
+
+variable "gcp_image" {
+  default = "ubuntu-os-cloud/ubuntu-2204-lts"
+}
+
+variable "private_registry" {
+  default = ""
+}
+
+variable "private_registry_username" {
+  default = ""
+}
+
+variable "private_registry_password" {
+  default   = ""
+  sensitive = true
+}

@@ -1,0 +1,38 @@
+# One GCE node VM. Reference analog: gcp-rancher-k8s-host/main.tf:32-64
+# (google_compute_instance + startup script), :66-73 (optional disk).
+
+provider "google" {
+  credentials = file(var.gcp_path_to_credentials)
+  project     = var.gcp_project_id
+  region      = var.gcp_compute_region
+}
+
+resource "google_compute_instance" "node" {
+  name         = var.hostname
+  machine_type = var.gcp_machine_type
+  zone         = var.gcp_zone
+  tags         = [var.gcp_compute_firewall_host_tag]
+
+  boot_disk {
+    initialize_params {
+      image = var.gcp_image
+      size  = var.gcp_disk_size_gb > 0 ? var.gcp_disk_size_gb : 100
+    }
+  }
+
+  network_interface {
+    network = var.gcp_compute_network_name
+    access_config {}
+  }
+
+  metadata_startup_script = templatefile(
+    "${path.module}/../files/install_node_agent.sh.tpl", {
+      api_url            = var.api_url
+      registration_token = var.registration_token
+      ca_checksum        = var.ca_checksum
+      node_role          = var.node_role
+      hostname           = var.hostname
+      extra_labels       = ""
+    }
+  )
+}

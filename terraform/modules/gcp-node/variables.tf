@@ -1,0 +1,64 @@
+variable "hostname" {}
+
+variable "api_url" {}
+
+variable "access_key" {}
+
+variable "secret_key" {
+  sensitive = true
+}
+
+variable "registration_token" {
+  sensitive = true
+}
+
+variable "ca_checksum" {}
+
+variable "node_role" {
+  default = "worker"
+}
+
+variable "gcp_path_to_credentials" {}
+
+variable "gcp_project_id" {}
+
+variable "gcp_compute_region" {
+  default = "us-central1"
+}
+
+variable "gcp_zone" {
+  default = "us-central1-a"
+}
+
+variable "gcp_machine_type" {
+  default = "n2-standard-4"
+}
+
+variable "gcp_image" {
+  default = "ubuntu-os-cloud/ubuntu-2204-lts"
+}
+
+variable "gcp_disk_size_gb" {
+  default = 0
+}
+
+variable "gcp_compute_network_name" {
+  description = "From the cluster module outputs (SURVEY §2.3)"
+}
+
+variable "gcp_compute_firewall_host_tag" {
+  description = "From the cluster module outputs (SURVEY §2.3)"
+}
+
+variable "private_registry" {
+  default = ""
+}
+
+variable "private_registry_username" {
+  default = ""
+}
+
+variable "private_registry_password" {
+  default   = ""
+  sensitive = true
+}

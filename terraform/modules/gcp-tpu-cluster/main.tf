@@ -1,0 +1,49 @@
+# Cloud TPU cluster envelope: registration + the VPC the TPU slices attach
+# to. Extends the gcp-cluster module shape (reference analog:
+# gcp-rancher-k8s/main.tf) with TPU-appropriate firewall rules: slice hosts
+# talk k8s over DCN, and the jax.distributed coordinator port must be open
+# between hosts (ICI traffic never touches the VPC — it rides the slice's own
+# interconnect).
+
+provider "google" {
+  credentials = file(var.gcp_path_to_credentials)
+  project     = var.gcp_project_id
+  region      = var.gcp_compute_region
+}
+
+data "external" "register_cluster" {
+  program = ["sh", "${path.module}/../files/register_cluster.sh"]
+  query = {
+    api_url          = var.api_url
+    access_key       = var.access_key
+    secret_key       = var.secret_key
+    name             = var.name
+    k8s_version      = var.k8s_version
+    network_provider = var.k8s_network_provider
+  }
+}
+
+resource "google_compute_network" "cluster" {
+  name                    = "${var.name}-network"
+  auto_create_subnetworks = true
+}
+
+resource "google_compute_firewall" "cluster" {
+  name    = "${var.name}-firewall"
+  network = google_compute_network.cluster.name
+
+  allow {
+    protocol = "tcp"
+    # 22 ssh, 6443 kube API, 10250 kubelet, NodePorts,
+    # 8471-8480 jax.distributed coordinator + barrier range (DCN)
+    ports = ["22", "6443", "10250", "30000-32767", "8471-8480"]
+  }
+
+  allow {
+    protocol = "udp"
+    ports    = ["8472"]
+  }
+
+  source_ranges = ["0.0.0.0/0"]
+  target_tags   = ["${var.name}-node"]
+}

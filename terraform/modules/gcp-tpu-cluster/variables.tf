@@ -1,0 +1,38 @@
+variable "name" {}
+
+variable "api_url" {}
+
+variable "access_key" {}
+
+variable "secret_key" {
+  sensitive = true
+}
+
+variable "k8s_version" {
+  default = "v1.31.1"
+}
+
+variable "k8s_network_provider" {
+  default = "calico"
+}
+
+variable "gcp_path_to_credentials" {}
+
+variable "gcp_project_id" {}
+
+variable "gcp_compute_region" {
+  default = "us-central1"
+}
+
+variable "private_registry" {
+  default = ""
+}
+
+variable "private_registry_username" {
+  default = ""
+}
+
+variable "private_registry_password" {
+  default   = ""
+  sensitive = true
+}

@@ -1,0 +1,53 @@
+# One TPU pod slice as a single schedulable node group.
+#
+# No reference analog — this is the north-star module (BASELINE.json): where
+# gcp-rancher-k8s-host/main.tf:32-64 creates ONE VM, this creates one
+# google_tpu_v2_vm spanning var.tpu_hosts hosts (a v5e/v5p/v6e slice is one
+# resource, one gang-schedulable unit). Every host boots the TPU agent
+# script, which joins the cluster control plane and writes the
+# jax.distributed env (coordinator, process ids, topology) — SURVEY §5.8.
+
+provider "google" {
+  credentials = file(var.gcp_path_to_credentials)
+  project     = var.gcp_project_id
+  region      = var.gcp_compute_region
+}
+
+resource "google_tpu_v2_vm" "slice" {
+  name             = var.hostname
+  zone             = var.gcp_zone
+  runtime_version  = var.tpu_runtime_version
+  accelerator_type = var.tpu_accelerator_type
+
+  network_config {
+    network             = var.gcp_compute_network_name
+    enable_external_ips = true
+  }
+
+  scheduling_config {
+    preemptible = var.tpu_provisioning_model == "spot"
+    reserved    = var.tpu_provisioning_model == "reserved"
+  }
+
+  tags = [var.gcp_compute_firewall_host_tag]
+
+  metadata = {
+    startup-script = templatefile(
+      "${path.module}/../files/install_tpu_agent.sh.tpl", {
+        api_url            = var.api_url
+        registration_token = var.registration_token
+        ca_checksum        = var.ca_checksum
+        slice_name         = var.hostname
+        accelerator_type   = var.tpu_accelerator_type
+        slice_topology     = var.tpu_topology
+        num_hosts          = var.tpu_hosts
+        coordinator_port   = var.tpu_coordinator_port
+      }
+    )
+  }
+
+  labels = {
+    tpu-kubernetes-slice = var.hostname
+    tpu-kubernetes-role  = var.node_role
+  }
+}

@@ -1,0 +1,8 @@
+output "slice_name" {
+  value = google_tpu_v2_vm.slice.name
+}
+
+output "coordinator_address" {
+  # first host of the slice hosts the jax.distributed coordinator
+  value = "${google_tpu_v2_vm.slice.network_endpoints[0].ip_address}:${var.tpu_coordinator_port}"
+}

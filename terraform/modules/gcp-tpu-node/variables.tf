@@ -1,0 +1,83 @@
+variable "hostname" {
+  description = "Slice name (one module instance = one TPU pod slice)"
+}
+
+variable "api_url" {}
+
+variable "access_key" {}
+
+variable "secret_key" {
+  sensitive = true
+}
+
+variable "registration_token" {
+  sensitive = true
+}
+
+variable "ca_checksum" {}
+
+variable "node_role" {
+  default = "worker"
+}
+
+variable "gcp_path_to_credentials" {}
+
+variable "gcp_project_id" {}
+
+variable "gcp_compute_region" {
+  default = "us-east5"
+}
+
+variable "gcp_zone" {
+  default = "us-east5-a"
+}
+
+variable "tpu_accelerator_type" {
+  description = "e.g. v5e-4, v5p-32 (validated by topology/tpu.py at render time)"
+}
+
+variable "tpu_topology" {
+  description = "Physical ICI topology, e.g. 2x2x4 (derived, informational)"
+}
+
+variable "tpu_hosts" {
+  description = "Host count of the slice (derived from accelerator type)"
+}
+
+variable "tpu_chips" {
+  description = "Chip count of the slice (derived from accelerator type)"
+}
+
+variable "tpu_runtime_version" {
+  description = "TPU VM runtime (software) version"
+}
+
+variable "tpu_coordinator_port" {
+  default = 8476
+}
+
+variable "tpu_provisioning_model" {
+  description = "on-demand | spot | reserved"
+  default     = "on-demand"
+}
+
+variable "gcp_compute_network_name" {
+  description = "From the cluster module outputs (SURVEY §2.3)"
+}
+
+variable "gcp_compute_firewall_host_tag" {
+  description = "From the cluster module outputs (SURVEY §2.3)"
+}
+
+variable "private_registry" {
+  default = ""
+}
+
+variable "private_registry_username" {
+  default = ""
+}
+
+variable "private_registry_password" {
+  default   = ""
+  sensitive = true
+}

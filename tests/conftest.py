@@ -1,0 +1,24 @@
+"""Test configuration.
+
+JAX-dependent tests run on a virtual 8-device CPU mesh (multi-chip shardings
+are validated without TPU hardware); env must be set before jax is first
+imported anywhere in the process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+
+@pytest.fixture()
+def tk_home(tmp_path, monkeypatch):
+    """Hermetic ~/.tpu-kubernetes root."""
+    monkeypatch.setenv("TPU_K8S_HOME", str(tmp_path / "tk-home"))
+    return tmp_path / "tk-home"

@@ -1,0 +1,92 @@
+"""Backend tests: local filesystem + object-store (Manta-analog) semantics."""
+
+import json
+
+import pytest
+
+from tpu_kubernetes.backend import (
+    BackendError,
+    LocalBackend,
+    MemoryStore,
+    ObjectStoreBackend,
+)
+from tpu_kubernetes.state import State
+
+
+class TestLocalBackend:
+    def test_empty_root_lists_nothing(self, tmp_path):
+        b = LocalBackend(tmp_path / "nope")
+        assert b.states() == []
+
+    def test_persist_load_roundtrip(self, tmp_path):
+        b = LocalBackend(tmp_path)
+        s = State("dev")
+        s.add_cluster("gcp", "alpha", {"x": 1})
+        b.persist_state(s)
+        assert b.states() == ["dev"]
+        s2 = b.state("dev")
+        assert s2.clusters() == {"alpha": "cluster_gcp_alpha"}
+
+    def test_missing_state_is_empty_doc(self, tmp_path):
+        b = LocalBackend(tmp_path)
+        s = b.state("ghost")
+        assert json.loads(s.to_bytes()) == {}
+
+    def test_delete_state(self, tmp_path):
+        b = LocalBackend(tmp_path)
+        b.persist_state(State("dev", {"module": {}}))
+        b.delete_state("dev")
+        assert b.states() == []
+        b.delete_state("dev")  # idempotent
+
+    def test_terraform_backend_config_colocated(self, tmp_path):
+        b = LocalBackend(tmp_path)
+        path, cfg = b.state_terraform_config("dev")
+        assert path == "terraform.backend.local"
+        assert cfg["path"].startswith(str(tmp_path))
+        assert cfg["path"].endswith("terraform.tfstate")
+
+    def test_respects_tpu_k8s_home(self, tk_home):
+        b = LocalBackend()
+        assert str(b.root) == str(tk_home)
+
+
+class TestObjectStoreBackend:
+    def test_roundtrip_and_listing(self):
+        store = MemoryStore()
+        b = ObjectStoreBackend(store, bucket="bkt")
+        s = State("dev")
+        s.add_cluster("gcp-tpu", "alpha", {})
+        b.persist_state(s)
+        b.persist_state(State("prod", {"module": {}}))
+        assert b.states() == ["dev", "prod"]
+        assert b.state("dev").clusters() == {"alpha": "cluster_gcp-tpu_alpha"}
+
+    def test_delete_removes_all_objects(self):
+        store = MemoryStore()
+        b = ObjectStoreBackend(store, bucket="bkt")
+        b.persist_state(State("dev", {"module": {}}))
+        b.delete_state("dev")
+        assert b.states() == []
+        assert store.list("") == []
+
+    def test_terraform_backend_config_is_gcs(self):
+        b = ObjectStoreBackend(MemoryStore(), bucket="bkt")
+        path, cfg = b.state_terraform_config("dev")
+        assert path == "terraform.backend.gcs"
+        assert cfg == {"bucket": "bkt", "prefix": "tpu-kubernetes/dev"}
+
+    def test_lock_contention_raises(self):
+        store = MemoryStore()
+        b = ObjectStoreBackend(store, bucket="bkt")
+        store.put("tpu-kubernetes/dev/.lock", json.dumps({"acquired_at": 1e18}).encode())
+        with pytest.raises(BackendError, match="locked"):
+            b.persist_state(State("dev", {"module": {}}))
+
+    def test_stale_lock_is_broken(self):
+        store = MemoryStore()
+        b = ObjectStoreBackend(store, bucket="bkt", lock_ttl_s=0.0)
+        store.put("tpu-kubernetes/dev/.lock", json.dumps({"acquired_at": 0}).encode())
+        b.persist_state(State("dev", {"module": {}}))  # should not raise
+        assert store.get("tpu-kubernetes/dev/main.tf.json") is not None
+        assert store.get("tpu-kubernetes/dev/.lock") is None

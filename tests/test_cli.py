@@ -1,0 +1,117 @@
+"""CLI end-to-end tests through main() with a hermetic home + dry-run
+executor (no terraform binary on PATH → FakeExecutor).
+
+Mirrors reference cmd/version_test.go:10-48 (version output) plus full
+silent-install flows (examples/silent-install analog,
+reference: create/cluster.go:165-217)."""
+
+import json
+
+import pytest
+
+import tpu_kubernetes
+from tpu_kubernetes.cli import main
+
+
+@pytest.fixture()
+def cli_home(tk_home, monkeypatch):
+    # ensure a real terraform on PATH (if any) is not picked up
+    monkeypatch.setenv("TPU_K8S_TERRAFORM_BIN", "definitely-not-terraform-xyz")
+    return tk_home
+
+
+def run(args):
+    return main(args)
+
+
+def test_version_output(capsys):
+    assert run(["version"]) == 0
+    out = capsys.readouterr().out
+    assert out.strip() == f"tpu-kubernetes v{tpu_kubernetes.__version__}"
+
+
+def test_bad_set_flag(cli_home, capsys):
+    assert run(["--set", "noequals", "create", "manager"]) == 2
+
+
+def write_yaml(tmp_path, name, content):
+    f = tmp_path / name
+    f.write_text(content)
+    return str(f)
+
+
+MANAGER_YAML = """
+backend_provider: local
+manager_cloud_provider: baremetal
+name: dev
+manager_admin_password: hunter2
+host: 10.0.0.10
+ssh_user: ubuntu
+key_path: ~/.ssh/id_rsa
+"""
+
+TPU_CLUSTER_YAML = """
+backend_provider: local
+cluster_manager: dev
+cluster_cloud_provider: gcp-tpu
+name: tpu-alpha
+k8s_version: v1.31.1
+k8s_network_provider: cilium
+gcp_path_to_credentials: /nonexistent/creds.json
+gcp_project_id: proj-1
+gcp_compute_region: us-east5
+gcp_zone: us-east5-a
+nodes:
+  - tpu_accelerator_type: v5p-32
+    node_count: 2
+    hostname_prefix: trainer
+    mesh_shape: data=2,fsdp=4,tensor=2
+"""
+
+
+def test_silent_install_end_to_end(cli_home, tmp_path, capsys):
+    """create manager → create cluster (TPU slices) → get → destroy."""
+    mgr = write_yaml(tmp_path, "mgr.yaml", MANAGER_YAML)
+    assert run(["--config", mgr, "--non-interactive", "create", "manager"]) == 0
+
+    cluster = write_yaml(tmp_path, "cluster.yaml", TPU_CLUSTER_YAML)
+    assert run(["--config", cluster, "--non-interactive", "create", "cluster"]) == 0
+
+    state_file = cli_home / "dev" / "main.tf.json"
+    doc = json.loads(state_file.read_text())
+    assert "cluster_gcp-tpu_tpu-alpha" in doc["module"]
+    assert "node_gcp-tpu_tpu-alpha_trainer-1" in doc["module"]
+    assert doc["module"]["node_gcp-tpu_tpu-alpha_trainer-2"]["tpu_topology"] == "2x2x4"
+
+    capsys.readouterr()
+    assert run([
+        "--non-interactive", "--set", "cluster_manager=dev", "get", "manager",
+    ]) == 0
+    assert json.loads(capsys.readouterr().out) == {}  # dry-run outputs
+
+    # destroy in dry-run mode (no terraform) must NOT forget state —
+    # the infrastructure was never actually destroyed
+    assert run([
+        "--non-interactive",
+        "--set", "cluster_manager=dev", "--set", "cluster_name=tpu-alpha",
+        "destroy", "cluster",
+    ]) == 0
+    doc = json.loads(state_file.read_text())
+    assert "cluster_gcp-tpu_tpu-alpha" in doc["module"]
+
+    assert run([
+        "--non-interactive", "--set", "cluster_manager=dev", "destroy", "manager",
+    ]) == 0
+    assert state_file.exists()
+
+
+def test_missing_required_key_exits_1(cli_home, capsys):
+    assert run(["--non-interactive", "create", "manager"]) == 1
+    assert "must be specified" in capsys.readouterr().err
+
+
+def test_destroy_unknown_manager_exits_1(cli_home, capsys):
+    assert run([
+        "--non-interactive", "--set", "cluster_manager=ghost", "destroy", "manager",
+    ]) == 1
+    assert "no cluster managers" in capsys.readouterr().err

@@ -1,0 +1,104 @@
+"""Config precedence + non-interactive gating tests.
+
+Mirrors the reference's universal viper idiom (create/manager.go:33-55) and
+backend selection tests (util/backend_prompt_test.go:9-103)."""
+
+import pytest
+
+from tpu_kubernetes.config import Config, ConfigError
+from tpu_kubernetes.util.prompts import PromptError, ScriptedPrompter
+
+
+def test_explicit_value_wins():
+    c = Config({"name": "from-file"}, env={"TPU_K8S_NAME": "from-env"})
+    c.set("name", "from-flag")
+    assert c.get("name") == "from-flag"
+
+
+def test_file_beats_env():
+    c = Config({"name": "from-file"}, env={"TPU_K8S_NAME": "from-env"})
+    assert c.get("name") == "from-file"
+
+
+def test_env_fallback():
+    c = Config({}, env={"TPU_K8S_GCP_PROJECT_ID": "proj-1"})
+    assert c.get("gcp_project_id") == "proj-1"
+
+
+def test_non_interactive_missing_is_error():
+    c = Config({}, non_interactive=True, env={})
+    with pytest.raises(ConfigError, match="gcp_project_id must be specified"):
+        c.get("gcp_project_id")
+
+
+def test_non_interactive_default_is_used():
+    c = Config({}, non_interactive=True, env={})
+    assert c.get("k8s_version", default="v1.29.0") == "v1.29.0"
+
+
+def test_prompt_fallback_and_caching():
+    p = ScriptedPrompter(answers=["answered"])
+    c = Config({}, prompter=p, env={})
+    assert c.get("name", prompt="cluster name") == "answered"
+    # second get must reuse the cached answer, not re-prompt
+    assert c.get("name") == "answered"
+
+
+def test_choices_select_prompt():
+    p = ScriptedPrompter(answers=["gcp-tpu"])
+    c = Config({}, prompter=p, env={})
+    assert c.get("provider", choices=["gcp", "gcp-tpu"]) == "gcp-tpu"
+
+
+def test_choices_rejects_bad_explicit_value():
+    c = Config({"provider": "floppy"}, env={})
+    with pytest.raises(ConfigError, match="must be one of"):
+        c.get("provider", choices=["gcp", "gcp-tpu"])
+
+
+def test_unexpected_prompt_is_hard_error():
+    c = Config({}, prompter=ScriptedPrompter(), env={})
+    with pytest.raises(PromptError, match="unexpected prompt"):
+        c.get("name")
+
+
+def test_get_bool_and_int():
+    c = Config({"count": "3", "ha": "true"}, env={})
+    assert c.get_int("count") == 3
+    assert c.get_bool("ha") is True
+    assert c.get_bool("missing", default=False) is False
+
+
+def test_int_validation():
+    c = Config({"count": "three"}, env={})
+    with pytest.raises(ConfigError, match="must be an integer"):
+        c.get_int("count")
+
+
+def test_confirm_force_and_non_interactive():
+    assert Config({"force": True}, env={}).confirm("destroy all?") is True
+    assert Config({}, non_interactive=True, env={}).confirm("destroy all?") is True
+    p = ScriptedPrompter(confirm_answers=[False])
+    assert Config({}, prompter=p, env={}).confirm("destroy all?") is False
+
+
+def test_load_from_yaml_file(tmp_path, tk_home):
+    f = tmp_path / "cfg.yaml"
+    f.write_text("name: dev\nbackend_provider: local\n")
+    c = Config.load(str(f), non_interactive=True)
+    assert c.get("name") == "dev"
+    assert c.get("backend_provider") == "local"
+
+
+def test_fresh_scope_keeps_explicit_overrides_drops_prompt_cache():
+    """--set overrides survive a fresh node-group scope; prompt answers
+    don't (so interactive loops re-prompt per group)."""
+    from tpu_kubernetes.create.cluster import _scoped_config
+
+    p = ScriptedPrompter(answers=["answered"])
+    cfg = Config({}, prompter=p, env={})
+    cfg.set("node_count", "3")                      # explicit --set
+    cfg.get("hostname_prefix", prompt="prefix")     # prompt-cached
+    child = _scoped_config(cfg, {}, fresh=True)
+    assert child.peek("node_count") == "3"
+    assert child.is_set("hostname_prefix") is False

@@ -1,0 +1,90 @@
+"""Executor tests: rendering, fake recording, real-terraform arg assembly."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tpu_kubernetes.shell import (
+    ExecutorError,
+    FakeExecutor,
+    TerraformExecutor,
+    render_to_dir,
+)
+from tpu_kubernetes.state import State
+
+
+def make_state():
+    s = State("dev")
+    s.set_terraform_backend_config("terraform.backend.local", {"path": "/tmp/x"})
+    s.add_cluster("gcp", "alpha", {"source": "./modules/gcp-cluster"})
+    return s
+
+
+def test_render_to_dir(tmp_path):
+    path = render_to_dir(make_state(), tmp_path)
+    assert path.name == "main.tf.json"
+    doc = json.loads(path.read_text())
+    assert "cluster_gcp_alpha" in doc["module"]
+
+
+def test_fake_executor_records_apply_and_destroy():
+    ex = FakeExecutor()
+    s = make_state()
+    ex.apply(s)
+    ex.destroy(s, targets=["module.cluster_gcp_alpha"])
+    assert [c.command for c in ex.calls] == ["apply", "destroy"]
+    assert ex.calls[0].document["module"]["cluster_gcp_alpha"]["source"].endswith(
+        "gcp-cluster"
+    )
+    assert ex.calls[1].targets == ("module.cluster_gcp_alpha",)
+
+
+def test_fake_executor_canned_outputs():
+    ex = FakeExecutor(outputs={"cluster-manager": {"rancher_url": "https://m"}})
+    assert ex.output(make_state(), "cluster-manager")["rancher_url"] == "https://m"
+    assert ex.output(make_state(), "missing") == {}
+
+
+def test_fake_executor_failure_injection():
+    ex = FakeExecutor(fail_with="quota exceeded")
+    with pytest.raises(ExecutorError, match="quota exceeded"):
+        ex.apply(make_state())
+    assert ex.calls == []
+
+
+def test_terraform_executor_missing_binary_is_clear_error():
+    ex = TerraformExecutor(terraform_bin="definitely-not-terraform-xyz")
+    with pytest.raises(ExecutorError, match="not found"):
+        ex.apply(make_state())
+
+
+def test_terraform_executor_runs_real_subprocess(tmp_path):
+    """Use a stub 'terraform' script to verify command assembly end-to-end."""
+    stub = tmp_path / "terraform"
+    log = tmp_path / "calls.log"
+    stub.write_text(
+        "#!/bin/sh\n"
+        f'echo "$@" >> {log}\n'
+        'if [ "$1" = "output" ]; then echo \'{"cluster-manager__k": {"value": "v"}}\'; fi\n'
+    )
+    stub.chmod(0o755)
+    ex = TerraformExecutor(terraform_bin=str(stub), stream_output=False)
+    s = make_state()
+    ex.apply(s)
+    ex.destroy(s, targets=["module.cluster_gcp_alpha"])
+    out = ex.output(s, "cluster-manager")
+    calls = log.read_text().splitlines()
+    assert calls[0] == "init -force-copy"
+    assert calls[1] == "apply -auto-approve"
+    assert calls[3] == "destroy -auto-approve -target=module.cluster_gcp_alpha"
+    assert out == {"k": "v"}
+
+
+def test_terraform_executor_nonzero_exit(tmp_path):
+    stub = tmp_path / "terraform"
+    stub.write_text("#!/bin/sh\nexit 3\n")
+    stub.chmod(0o755)
+    ex = TerraformExecutor(terraform_bin=str(stub), stream_output=False)
+    with pytest.raises(ExecutorError, match="status 3"):
+        ex.apply(make_state())

@@ -1,0 +1,61 @@
+"""SSH fingerprint derivation tests (reference: util/ssh_utils.go:13-42).
+
+Consumed by the triton provider flow (Triton/Manta APIs identify keys by MD5
+fingerprint)."""
+
+import hashlib
+import base64
+
+import pytest
+
+cryptography = pytest.importorskip("cryptography")
+
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import ed25519
+
+from tpu_kubernetes.util.ssh import (
+    SSHKeyError,
+    SSHKeyNeedsPassphrase,
+    public_key_md5_fingerprint,
+)
+
+
+def write_key(tmp_path, passphrase=None):
+    key = ed25519.Ed25519PrivateKey.generate()
+    if passphrase:
+        # PKCS8 PEM encryption (OpenSSH-format encryption needs bcrypt,
+        # which this environment lacks)
+        fmt = serialization.PrivateFormat.PKCS8
+        enc = serialization.BestAvailableEncryption(passphrase.encode())
+    else:
+        fmt = serialization.PrivateFormat.OpenSSH
+        enc = serialization.NoEncryption()
+    pem = key.private_bytes(serialization.Encoding.PEM, fmt, enc)
+    path = tmp_path / "id_ed25519"
+    path.write_bytes(pem)
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.OpenSSH, serialization.PublicFormat.OpenSSH
+    )
+    blob = base64.b64decode(pub.split()[1])
+    digest = hashlib.md5(blob).hexdigest()
+    expected = ":".join(digest[i:i + 2] for i in range(0, len(digest), 2))
+    return path, expected
+
+
+def test_fingerprint_matches_openssh_blob(tmp_path):
+    path, expected = write_key(tmp_path)
+    assert public_key_md5_fingerprint(str(path)) == expected
+
+
+def test_encrypted_key_needs_passphrase(tmp_path):
+    path, expected = write_key(tmp_path, passphrase="sekrit")
+    with pytest.raises(SSHKeyNeedsPassphrase):
+        public_key_md5_fingerprint(str(path))
+    assert public_key_md5_fingerprint(str(path), passphrase="sekrit") == expected
+
+
+def test_garbage_key_is_clear_error(tmp_path):
+    path = tmp_path / "junk"
+    path.write_text("not a key")
+    with pytest.raises(SSHKeyError):
+        public_key_md5_fingerprint(str(path))

@@ -1,0 +1,116 @@
+"""State document CRUD + key-scheme tests.
+
+Ports the intent of reference state/state_test.go:8-190 and the key scheme at
+state/state.go:55-77.
+"""
+
+import json
+
+import pytest
+
+from tpu_kubernetes.state import (
+    MANAGER_KEY,
+    State,
+    StateError,
+    cluster_key_parts,
+    node_key_parts,
+)
+
+
+def test_empty_state_roundtrip():
+    s = State("dev")
+    assert s.name == "dev"
+    assert json.loads(s.to_bytes()) == {}
+
+
+def test_set_get_delete_dotted_paths():
+    s = State("dev")
+    s.set("module.x.source", "./modules/gcp-tpu")
+    assert s.get("module.x.source") == "./modules/gcp-tpu"
+    assert s.get("module.missing") is None
+    assert s.get("module.missing", "fallback") == "fallback"
+    s.delete("module.x")
+    assert s.get("module.x") is None
+    s.delete("module.nothing.there")  # no-op
+
+
+def test_manager_key():
+    s = State("dev")
+    key = s.set_manager({"source": "./modules/gcp-manager", "name": "dev"})
+    assert key == MANAGER_KEY
+    assert s.manager()["name"] == "dev"
+
+
+def test_add_cluster_and_enumerate():
+    s = State("dev")
+    k1 = s.add_cluster("gcp", "alpha", {"source": "x"})
+    k2 = s.add_cluster("gcp-tpu", "beta", {"source": "y"})
+    assert k1 == "cluster_gcp_alpha"
+    assert k2 == "cluster_gcp-tpu_beta"
+    assert s.clusters() == {"alpha": k1, "beta": k2}
+
+
+def test_add_node_and_enumerate_per_cluster():
+    s = State("dev")
+    ck = s.add_cluster("gcp", "alpha", {})
+    s.add_cluster("gcp", "alphaz", {})  # prefix-adjacent cluster must not leak
+    s.add_node("gcp", "alpha", "worker-1", {"a": 1})
+    s.add_node("gcp", "alpha", "worker-2", {"a": 2})
+    s.add_node("gcp", "alphaz", "worker-1", {"a": 3})
+    assert s.nodes(ck) == {
+        "worker-1": "node_gcp_alpha_worker-1",
+        "worker-2": "node_gcp_alpha_worker-2",
+    }
+
+
+def test_underscore_names_rejected():
+    s = State("dev")
+    with pytest.raises(StateError):
+        s.add_cluster("gcp", "bad_name", {})
+    with pytest.raises(StateError):
+        s.add_node("gcp", "ok", "bad_host_name", {})
+
+
+def test_nodes_requires_cluster_key():
+    s = State("dev")
+    with pytest.raises(StateError):
+        s.nodes("node_gcp_a_b")
+
+
+def test_key_parsing():
+    assert cluster_key_parts("cluster_gcp_alpha") == ("gcp", "alpha")
+    assert cluster_key_parts("cluster_gcp-tpu_beta-1") == ("gcp-tpu", "beta-1")
+    assert cluster_key_parts("node_gcp_a_b") is None
+    assert cluster_key_parts("cluster_gcp") is None
+    assert node_key_parts("node_gcp_alpha_worker-1") == ("gcp", "alpha", "worker-1")
+    assert node_key_parts("cluster_gcp_alpha") is None
+    assert node_key_parts("node_gcp_alpha") is None
+
+
+def test_serialization_roundtrip_from_bytes():
+    s = State("dev")
+    s.add_cluster("gcp", "alpha", {"k8s_version": "v1.29.0"})
+    s2 = State("dev", s.to_bytes())
+    assert s2.clusters() == {"alpha": "cluster_gcp_alpha"}
+    assert s2.get("module.cluster_gcp_alpha.k8s_version") == "v1.29.0"
+
+
+def test_terraform_backend_config_block():
+    s = State("dev")
+    s.set_terraform_backend_config("terraform.backend.local", {"path": "/x/y"})
+    assert s.get("terraform.backend.local.path") == "/x/y"
+
+
+def test_dotted_names_rejected_dashed_hostnames_work():
+    """Dots are invalid in Terraform module names, so dotted names are
+    rejected; IP-derived hostnames arrive pre-dashed (10.0.0.21 → 10-0-0-21)
+    and are stored as plain (non-dotted-path) module keys (regression)."""
+    s = State("dev")
+    ck = s.add_cluster("baremetal", "alpha", {})
+    with pytest.raises(StateError):
+        s.add_node("baremetal", "alpha", "10.0.0.21", {})
+    s.add_node("baremetal", "alpha", "10-0-0-21", {"host": "10.0.0.21"})
+    assert s.nodes(ck) == {"10-0-0-21": "node_baremetal_alpha_10-0-0-21"}
+    assert s.module("node_baremetal_alpha_10-0-0-21")["host"] == "10.0.0.21"
+    s.delete_module("node_baremetal_alpha_10-0-0-21")
+    assert s.nodes(ck) == {}

@@ -1,0 +1,101 @@
+"""TPU topology parsing + mesh validation tests."""
+
+import pytest
+
+from tpu_kubernetes.topology import (
+    TopologyError,
+    parse_accelerator_type,
+    slice_host_env,
+    validate_mesh,
+)
+
+
+@pytest.mark.parametrize(
+    "accel,chips,hosts,topology",
+    [
+        ("v5e-4", 4, 1, "2x2"),
+        ("v5e-8", 8, 1, "2x4"),
+        ("v5e-16", 16, 4, "4x4"),
+        ("v5e-256", 256, 64, "16x16"),
+        ("v5p-8", 4, 1, "2x2x1"),
+        ("v5p-32", 16, 4, "2x2x4"),
+        ("v5p-256", 128, 32, "4x4x8"),
+        ("v4-8", 4, 1, "2x2x1"),
+        ("v6e-8", 8, 1, "2x4"),
+        ("v5litepod-4", 4, 1, "2x2"),
+    ],
+)
+def test_parse_known_types(accel, chips, hosts, topology):
+    t = parse_accelerator_type(accel)
+    assert t.chips == chips
+    assert t.hosts == hosts
+    assert t.topology == topology
+    assert t.devices == chips
+
+
+def test_parse_normalizes_case_and_litepod():
+    assert parse_accelerator_type("V5P-32").generation == "v5p"
+    assert parse_accelerator_type("v5litepod-4").generation == "v5e"
+
+
+def test_multi_host_flag():
+    assert not parse_accelerator_type("v5e-8").multi_host
+    assert parse_accelerator_type("v5p-32").multi_host
+
+
+def test_unknown_size_factorizes_consistently():
+    t = parse_accelerator_type("v5e-32")
+    dims = t.dims
+    assert len(dims) == 2
+    assert dims[0] * dims[1] == 32
+
+
+@pytest.mark.parametrize("bad", ["v9z-8", "v5p", "v5p-x", "v5p-7", "tpu"])
+def test_parse_rejects_garbage(bad):
+    with pytest.raises(TopologyError):
+        parse_accelerator_type(bad)
+
+
+def test_validate_mesh_accepts_exact_fit():
+    t = parse_accelerator_type("v5p-32")  # 16 chips
+    validate_mesh(t, {"data": 2, "fsdp": 4, "tensor": 2})
+
+
+def test_validate_mesh_rejects_wrong_total():
+    t = parse_accelerator_type("v5e-4")
+    with pytest.raises(TopologyError, match="wants 8 devices"):
+        validate_mesh(t, {"data": 2, "tensor": 4})
+
+
+def test_validate_mesh_rejects_nonpositive_axis():
+    t = parse_accelerator_type("v5e-4")
+    with pytest.raises(TopologyError, match=">=1"):
+        validate_mesh(t, {"data": 0, "tensor": 4})
+
+
+def test_slice_host_env_contract():
+    t = parse_accelerator_type("v5p-32")
+    env = slice_host_env(t, "10.0.0.2:8476", host_index=3)
+    assert env["JAX_COORDINATOR_ADDRESS"] == "10.0.0.2:8476"
+    assert env["JAX_NUM_PROCESSES"] == "4"
+    assert env["JAX_PROCESS_ID"] == "3"
+    assert env["TPU_SLICE_TOPOLOGY"] == "2x2x4"
+
+
+def test_slice_host_env_range_check():
+    t = parse_accelerator_type("v5e-4")
+    with pytest.raises(TopologyError):
+        slice_host_env(t, "c:1", host_index=1)
+
+
+def test_api_name_v5e_maps_to_v5litepod():
+    assert parse_accelerator_type("v5e-4").api_name == "v5litepod-4"
+    assert parse_accelerator_type("v5litepod-16").api_name == "v5litepod-16"
+    assert parse_accelerator_type("v5p-32").api_name == "v5p-32"
+
+
+def test_multi_host_v5e_places_4_chips_per_vm():
+    t = parse_accelerator_type("v5e-16")
+    assert (t.hosts, t.chips_per_host) == (4, 4)
+    t8 = parse_accelerator_type("v5e-8")
+    assert (t8.hosts, t8.chips_per_host) == (1, 8)

@@ -1,0 +1,32 @@
+"""Hostname series + name validation tests.
+
+Ports the table cases of reference create/node_test.go:8-36."""
+
+from tpu_kubernetes.util import new_hostnames, validate_name
+
+
+def test_hostname_series_fresh():
+    assert new_hostnames("worker", 3, set()) == ["worker-1", "worker-2", "worker-3"]
+
+
+def test_hostname_series_fills_gaps():
+    existing = {"worker-1", "worker-3"}
+    assert new_hostnames("worker", 3, existing) == ["worker-2", "worker-4", "worker-5"]
+
+
+def test_hostname_series_ignores_other_prefixes():
+    existing = {"etcd-1", "etcd-2"}
+    assert new_hostnames("worker", 2, existing) == ["worker-1", "worker-2"]
+
+
+def test_hostname_series_zero():
+    assert new_hostnames("worker", 0, set()) == []
+
+
+def test_validate_name():
+    assert validate_name("dev-cluster") is None
+    assert validate_name("a-b-c1") is None
+    assert validate_name("a.b") is not None  # dots break terraform module names
+    assert validate_name("") is not None
+    assert validate_name("has_underscore") is not None
+    assert validate_name("-leading-dash") is not None

@@ -1,0 +1,339 @@
+"""Workflow tests: the full create/destroy/get pipeline, hermetically.
+
+The reference can only test the validation prefix of each workflow because it
+has no shell mocking (SURVEY §4); with the FakeExecutor the whole pipeline —
+document rendered, commands issued, state persisted — is assertable. Error
+paths mirror the reference's non-interactive tests
+(destroy/cluster_test.go:19-100, get/cluster_test.go)."""
+
+import pytest
+
+from tpu_kubernetes import create, destroy, get
+from tpu_kubernetes.backend import LocalBackend
+from tpu_kubernetes.config import Config, ConfigError
+from tpu_kubernetes.providers.base import ProviderError
+from tpu_kubernetes.shell import FakeExecutor
+from tpu_kubernetes.state import MANAGER_KEY
+
+
+def make_env(tmp_path, values):
+    backend = LocalBackend(tmp_path / "backend")
+    cfg = Config(dict(values), non_interactive=True, env={})
+    return backend, cfg, FakeExecutor()
+
+
+MANAGER_VALUES = {
+    "manager_cloud_provider": "baremetal",
+    "name": "dev",
+    "manager_admin_password": "hunter2",
+    "host": "10.0.0.10",
+    "ssh_user": "ubuntu",
+    "key_path": "~/.ssh/id_rsa",
+}
+
+
+def create_manager(tmp_path, extra=None):
+    backend, cfg, ex = make_env(tmp_path, {**MANAGER_VALUES, **(extra or {})})
+    state = create.new_manager(backend, cfg, ex)
+    return backend, state, ex
+
+
+class TestCreateManager:
+    def test_happy_path_persists_and_applies(self, tmp_path):
+        backend, state, ex = create_manager(tmp_path)
+        assert backend.states() == ["dev"]
+        assert [c.command for c in ex.calls] == ["apply"]
+        doc = ex.calls[0].document
+        mgr = doc["module"][MANAGER_KEY]
+        assert mgr["host"] == "10.0.0.10"
+        assert mgr["admin_password"] == "hunter2"
+        assert mgr["source"].endswith("baremetal-manager")
+        # terraform tfstate co-location block present
+        assert "local" in doc["terraform"]["backend"]
+
+    def test_duplicate_name_rejected(self, tmp_path):
+        create_manager(tmp_path)
+        backend, cfg, ex = make_env(tmp_path, MANAGER_VALUES)
+        backend.root = (tmp_path / "backend")
+        with pytest.raises(ProviderError, match="already exists"):
+            create.new_manager(backend, cfg, ex)
+
+    def test_missing_key_is_config_error(self, tmp_path):
+        values = dict(MANAGER_VALUES)
+        del values["host"]
+        backend, cfg, ex = make_env(tmp_path, values)
+        with pytest.raises(ConfigError, match="host must be specified"):
+            create.new_manager(backend, cfg, ex)
+
+    def test_provider_without_manager_support(self, tmp_path):
+        backend, cfg, ex = make_env(
+            tmp_path, {**MANAGER_VALUES, "manager_cloud_provider": "gcp-tpu"}
+        )
+        with pytest.raises(ConfigError, match="must be one of"):
+            create.new_manager(backend, cfg, ex)
+
+    def test_state_persisted_before_apply(self, tmp_path):
+        """Crash mid-apply must not lose intent (SURVEY §5.3 fix)."""
+        backend, cfg, _ = make_env(tmp_path, MANAGER_VALUES)
+        ex = FakeExecutor(fail_with="quota exceeded")
+        with pytest.raises(Exception, match="quota exceeded"):
+            create.new_manager(backend, cfg, ex)
+        assert backend.states() == ["dev"]  # intent survived
+
+
+CLUSTER_VALUES = {
+    "cluster_manager": "dev",
+    "cluster_cloud_provider": "baremetal",
+    "name": "alpha",
+    "k8s_version": "v1.31.1",
+    "k8s_network_provider": "calico",
+    "ssh_user": "ubuntu",
+    "key_path": "~/.ssh/id_rsa",
+}
+
+
+def create_cluster(tmp_path, extra=None, nodes=None):
+    backend, _, _ = create_manager(tmp_path)
+    values = {**CLUSTER_VALUES, **(extra or {})}
+    if nodes is not None:
+        values["nodes"] = nodes
+    cfg = Config(values, non_interactive=True, env={})
+    ex = FakeExecutor()
+    state = create.new_cluster(backend, cfg, ex)
+    return backend, state, ex
+
+
+class TestCreateCluster:
+    def test_happy_path_no_nodes(self, tmp_path):
+        backend, state, ex = create_cluster(tmp_path)
+        assert state.clusters() == {"alpha": "cluster_baremetal_alpha"}
+        cluster = ex.calls[0].document["module"]["cluster_baremetal_alpha"]
+        # manager-output interpolation contract (SURVEY §2.3)
+        assert cluster["api_url"] == "${module.cluster-manager.api_url}"
+        assert cluster["k8s_version"] == "v1.31.1"
+
+    def test_nodes_fanout_from_yaml(self, tmp_path):
+        nodes = [
+            {"node_role": "etcd", "hosts": "10.0.0.21,10.0.0.22,10.0.0.23"},
+            {"node_role": "control", "hosts": "10.0.0.31"},
+            {"node_role": "worker", "hosts": "10.0.0.41,10.0.0.42"},
+        ]
+        backend, state, ex = create_cluster(tmp_path, nodes=nodes)
+        hostnames = state.nodes("cluster_baremetal_alpha")
+        assert len(hostnames) == 6
+        doc = ex.calls[0].document
+        etcd = doc["module"]["node_baremetal_alpha_10-0-0-21"]
+        assert etcd["node_role"] == "etcd"
+        assert etcd["registration_token"] == (
+            "${module.cluster_baremetal_alpha.registration_token}"
+        )
+        worker = doc["module"]["node_baremetal_alpha_10-0-0-42"]
+        assert worker["node_role"] == "worker"
+
+    def test_node_group_scoping_does_not_leak(self, tmp_path):
+        nodes = [
+            {"node_role": "etcd", "hosts": "10.0.0.21"},
+            {"hosts": "10.0.0.41"},  # no role → default worker, not etcd
+        ]
+        _, state, ex = create_cluster(tmp_path, nodes=nodes)
+        doc = ex.calls[0].document
+        assert doc["module"]["node_baremetal_alpha_10-0-0-41"]["node_role"] == "worker"
+
+    def test_duplicate_cluster_rejected(self, tmp_path):
+        backend, _, _ = create_cluster(tmp_path)
+        cfg = Config(dict(CLUSTER_VALUES), non_interactive=True, env={})
+        with pytest.raises(ProviderError, match="already exists"):
+            create.new_cluster(backend, cfg, FakeExecutor())
+
+    def test_no_managers_is_error(self, tmp_path):
+        backend, cfg, ex = make_env(tmp_path, CLUSTER_VALUES)
+        with pytest.raises(ProviderError, match="no cluster managers"):
+            create.new_cluster(backend, cfg, ex)
+
+
+TPU_CLUSTER_VALUES = {
+    "cluster_manager": "dev",
+    "cluster_cloud_provider": "gcp-tpu",
+    "name": "tpu-alpha",
+    "k8s_version": "v1.31.1",
+    "k8s_network_provider": "cilium",
+    "gcp_path_to_credentials": "/nonexistent/creds.json",
+    "gcp_project_id": "proj-1",
+    "gcp_compute_region": "us-east5",
+    "gcp_zone": "us-east5-a",
+}
+
+
+class TestCreateTpuCluster:
+    def test_tpu_cluster_with_slice_nodes(self, tmp_path):
+        nodes = [{
+            "tpu_accelerator_type": "v5p-32",
+            "node_count": 2,
+            "hostname_prefix": "trainer",
+            "mesh_shape": "data=2,fsdp=4,tensor=2",
+        }]
+        backend, _, _ = create_manager(tmp_path)
+        cfg = Config({**TPU_CLUSTER_VALUES, "nodes": nodes},
+                     non_interactive=True, env={})
+        ex = FakeExecutor()
+        state = create.new_cluster(backend, cfg, ex)
+        doc = ex.calls[0].document
+        slices = state.nodes("cluster_gcp-tpu_tpu-alpha")
+        assert sorted(slices) == ["trainer-1", "trainer-2"]
+        node = doc["module"]["node_gcp-tpu_tpu-alpha_trainer-1"]
+        assert node["tpu_accelerator_type"] == "v5p-32"
+        assert node["tpu_topology"] == "2x2x4"
+        assert node["tpu_hosts"] == 4
+        assert node["tpu_chips"] == 16
+        assert node["source"].endswith("gcp-tpu-node")
+        # network handles from the cluster module (contract §2.3)
+        assert node["gcp_compute_network_name"] == (
+            "${module.cluster_gcp-tpu_tpu-alpha.gcp_compute_network_name}"
+        )
+
+    def test_bad_mesh_is_rejected_before_apply(self, tmp_path):
+        nodes = [{
+            "tpu_accelerator_type": "v5e-4",
+            "mesh_shape": "data=8",
+        }]
+        backend, _, _ = create_manager(tmp_path)
+        cfg = Config({**TPU_CLUSTER_VALUES, "nodes": nodes},
+                     non_interactive=True, env={})
+        ex = FakeExecutor()
+        with pytest.raises(ProviderError, match="wants 8 devices"):
+            create.new_cluster(backend, cfg, ex)
+        assert ex.calls == []  # nothing applied
+
+    def test_tpu_provider_cannot_host_manager(self, tmp_path):
+        from tpu_kubernetes.providers import get_provider
+
+        assert get_provider("gcp-tpu").build_manager is None
+
+
+class TestCreateNode:
+    def test_add_node_to_existing_cluster(self, tmp_path):
+        backend, _, _ = create_cluster(tmp_path)
+        cfg = Config({
+            "cluster_manager": "dev",
+            "cluster_name": "alpha",
+            "hosts": "10.0.0.51",
+            "ssh_user": "ubuntu",
+            "key_path": "~/.ssh/id_rsa",
+        }, non_interactive=True, env={})
+        ex = FakeExecutor()
+        hostnames = create.new_node(backend, cfg, ex)
+        assert hostnames == ["10-0-0-51"]
+        state = backend.state("dev")
+        assert "10-0-0-51" in state.nodes("cluster_baremetal_alpha")
+
+    def test_duplicate_host_rejected(self, tmp_path):
+        backend, _, _ = create_cluster(
+            tmp_path, nodes=[{"hosts": "10.0.0.41"}]
+        )
+        cfg = Config({
+            "cluster_manager": "dev",
+            "cluster_name": "alpha",
+            "hosts": "10.0.0.41",
+            "ssh_user": "ubuntu",
+            "key_path": "~/.ssh/id_rsa",
+        }, non_interactive=True, env={})
+        with pytest.raises(ProviderError, match="already a node"):
+            create.new_node(backend, cfg, FakeExecutor())
+
+    def test_no_clusters_is_error(self, tmp_path):
+        backend, _, _ = create_manager(tmp_path)
+        cfg = Config({"cluster_manager": "dev"}, non_interactive=True, env={})
+        with pytest.raises(ProviderError, match="has no clusters"):
+            create.new_node(backend, cfg, FakeExecutor())
+
+
+class TestDestroy:
+    def test_destroy_node_targets_one_module(self, tmp_path):
+        backend, _, _ = create_cluster(tmp_path, nodes=[{"hosts": "10.0.0.41"}])
+        cfg = Config({
+            "cluster_manager": "dev", "cluster_name": "alpha",
+            "hostname": "10-0-0-41",
+        }, non_interactive=True, env={})
+        ex = FakeExecutor()
+        destroy.delete_node(backend, cfg, ex)
+        assert ex.calls[0].command == "destroy"
+        assert ex.calls[0].targets == ("module.node_baremetal_alpha_10-0-0-41",)
+        assert backend.state("dev").nodes("cluster_baremetal_alpha") == {}
+
+    def test_destroy_cluster_targets_cluster_and_nodes(self, tmp_path):
+        backend, _, _ = create_cluster(
+            tmp_path, nodes=[{"hosts": "10.0.0.41,10.0.0.42"}]
+        )
+        cfg = Config({"cluster_manager": "dev", "cluster_name": "alpha"},
+                     non_interactive=True, env={})
+        ex = FakeExecutor()
+        destroy.delete_cluster(backend, cfg, ex)
+        assert set(ex.calls[0].targets) == {
+            "module.cluster_baremetal_alpha",
+            "module.node_baremetal_alpha_10-0-0-41",
+            "module.node_baremetal_alpha_10-0-0-42",
+        }
+        state = backend.state("dev")
+        assert state.clusters() == {}
+        assert state.manager() is not None  # manager untouched
+
+    def test_destroy_manager_full_destroy_and_forget(self, tmp_path):
+        backend, _, _ = create_cluster(tmp_path)
+        cfg = Config({"cluster_manager": "dev"}, non_interactive=True, env={})
+        ex = FakeExecutor()
+        destroy.delete_manager(backend, cfg, ex)
+        assert ex.calls[0].command == "destroy"
+        assert ex.calls[0].targets == ()  # full destroy
+        assert backend.states() == []
+
+    def test_destroy_node_unknown_cluster_is_error(self, tmp_path):
+        backend, _, _ = create_manager(tmp_path)
+        cfg = Config({"cluster_manager": "dev", "cluster_name": "ghost"},
+                     non_interactive=True, env={})
+        with pytest.raises(ProviderError, match="has no clusters"):
+            destroy.delete_node(backend, cfg, FakeExecutor())
+
+
+class TestGet:
+    def test_get_manager_outputs(self, tmp_path):
+        backend, _, _ = create_manager(tmp_path)
+        cfg = Config({"cluster_manager": "dev"}, non_interactive=True, env={})
+        ex = FakeExecutor(outputs={
+            "cluster-manager": {"api_url": "https://manager.example"},
+        })
+        out = get.get_manager(backend, cfg, ex)
+        assert out == {"api_url": "https://manager.example"}
+
+    def test_get_cluster_outputs(self, tmp_path):
+        backend, _, _ = create_cluster(tmp_path)
+        cfg = Config({"cluster_manager": "dev", "cluster_name": "alpha"},
+                     non_interactive=True, env={})
+        ex = FakeExecutor(outputs={
+            "cluster_baremetal_alpha": {"registration_token": "tok"},
+        })
+        out = get.get_cluster(backend, cfg, ex)
+        assert out["registration_token"] == "tok"
+
+
+class TestRootOutputForwarding:
+    def test_create_injects_root_forwards(self, tmp_path):
+        _, state, ex = create_cluster(tmp_path)
+        doc = ex.calls[0].document
+        # manager + cluster outputs forwarded to root for `terraform output`
+        assert doc["output"]["cluster-manager__api_url"]["value"] == (
+            "${module.cluster-manager.api_url}"
+        )
+        assert doc["output"]["cluster-manager__secret_key"]["sensitive"] is True
+        assert doc["output"]["cluster_baremetal_alpha__registration_token"][
+            "value"
+        ] == "${module.cluster_baremetal_alpha.registration_token}"
+
+    def test_destroy_prunes_stale_forwards(self, tmp_path):
+        backend, _, _ = create_cluster(tmp_path)
+        cfg = Config({"cluster_manager": "dev", "cluster_name": "alpha"},
+                     non_interactive=True, env={})
+        destroy.delete_cluster(backend, cfg, FakeExecutor())
+        doc = backend.state("dev").to_dict()
+        stale = [k for k in doc.get("output", {}) if "cluster_baremetal_alpha" in k]
+        assert stale == []
+        assert "cluster-manager__api_url" in doc["output"]

@@ -1,0 +1,31 @@
+"""tpu-kubernetes: TPU-native multi-cloud Kubernetes provisioning framework.
+
+A brand-new framework with the capability surface of the reference
+``triton-kubernetes`` CLI (see SURVEY.md): an interactive/scriptable CLI that
+creates and destroys a global cluster manager (control plane), Kubernetes
+clusters across cloud providers, and individual nodes — persisting each
+deployment as a declarative Terraform-JSON state document in a pluggable
+backend and applying it through an executor.
+
+Unlike the reference, **Cloud TPU is a first-class provider**: the ``gcp-tpu``
+provider stands up v5e/v5p pod slices as slice-shaped node groups, and the
+in-tree JAX stack (``tpu_kubernetes.models`` / ``ops`` / ``parallel`` /
+``train``) provides the training job that runs on them — sharded over a
+``jax.sharding.Mesh`` with XLA collectives riding ICI.
+
+Layer map (mirrors reference SURVEY.md §1):
+  cli/       — command dispatch            (ref: cmd/)
+  config/    — precedence config system    (ref: viper wiring, cmd/root.go)
+  create/    — create workflows            (ref: create/)
+  destroy/   — destroy workflows           (ref: destroy/)
+  get/       — query workflows             (ref: get/)
+  util/      — prompt/UX utilities         (ref: util/)
+  state/     — state document model        (ref: state/state.go)
+  backend/   — state persistence backends  (ref: backend/)
+  shell/     — executors                   (ref: shell/)
+  providers/ — provider registry + configs (ref: create/*_{triton,aws,gcp,azure}.go)
+  topology/  — typed TPU slice topology    (new; no reference analog)
+  models/, ops/, parallel/, train/ — the TPU compute stack (new; north star)
+"""
+
+__version__ = "0.1.0"

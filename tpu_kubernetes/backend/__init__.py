@@ -1,0 +1,9 @@
+from tpu_kubernetes.backend.base import Backend, BackendError  # noqa: F401
+from tpu_kubernetes.backend.local import LocalBackend  # noqa: F401
+from tpu_kubernetes.backend.objectstore import (  # noqa: F401
+    GCSStore,
+    MemoryStore,
+    ObjectStore,
+    ObjectStoreBackend,
+    new_gcs_backend,
+)

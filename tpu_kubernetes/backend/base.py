@@ -1,0 +1,46 @@
+"""State persistence backend contract.
+
+reference: backend/backend.go:7-27 — the ``Backend`` interface with
+``State``, ``DeleteState``, ``PersistState``, ``States``, and
+``StateTerraformConfig`` (the last returns the ``terraform.backend.*`` block
+to inject into the document so terraform's own tfstate is co-located with the
+framework's config).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any
+
+from tpu_kubernetes.state import State
+
+
+class BackendError(Exception):
+    pass
+
+
+class Backend(abc.ABC):
+    """Persistence contract for named state documents."""
+
+    @abc.abstractmethod
+    def states(self) -> list[str]:
+        """Names of all persisted cluster managers. reference: backend/backend.go:13."""
+
+    @abc.abstractmethod
+    def state(self, name: str) -> State:
+        """Load (or create empty) the document for ``name``.
+        reference: backend/backend.go:9."""
+
+    @abc.abstractmethod
+    def persist_state(self, state: State) -> None:
+        """Write the document back. reference: backend/backend.go:11."""
+
+    @abc.abstractmethod
+    def delete_state(self, name: str) -> None:
+        """Remove all storage for ``name``. reference: backend/backend.go:10."""
+
+    @abc.abstractmethod
+    def state_terraform_config(self, name: str) -> tuple[str, Any]:
+        """Return ``(document_path, config_obj)`` for the ``terraform.backend.*``
+        block that co-locates terraform's tfstate with this backend.
+        reference: backend/backend.go:24-26."""

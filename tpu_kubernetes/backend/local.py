@@ -1,0 +1,74 @@
+"""Local-filesystem state backend.
+
+Layout (mirrors reference backend/local/backend.go:14-19):
+
+  ~/.tpu-kubernetes/<manager-name>/main.tf.json      — the state document
+  ~/.tpu-kubernetes/<manager-name>/terraform.tfstate — terraform's own state
+
+The root is overridable via the ``TPU_K8S_HOME`` environment variable or the
+constructor, which is what the tests use for hermeticity.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from pathlib import Path
+from typing import Any
+
+from tpu_kubernetes.backend.base import Backend, BackendError
+from tpu_kubernetes.state import State
+
+STATE_FILE = "main.tf.json"
+TFSTATE_FILE = "terraform.tfstate"
+
+
+def default_root() -> Path:
+    env = os.environ.get("TPU_K8S_HOME")
+    if env:
+        return Path(env)
+    return Path.home() / ".tpu-kubernetes"
+
+
+class LocalBackend(Backend):
+    """reference: backend/local/backend.go (New :28, terraform backend config :123-132)."""
+
+    name = "local"
+
+    def __init__(self, root: str | Path | None = None):
+        self.root = Path(root) if root is not None else default_root()
+
+    def _dir(self, name: str) -> Path:
+        return self.root / name
+
+    def states(self) -> list[str]:
+        if not self.root.is_dir():
+            return []
+        return sorted(
+            p.name for p in self.root.iterdir() if (p / STATE_FILE).is_file()
+        )
+
+    def state(self, name: str) -> State:
+        path = self._dir(name) / STATE_FILE
+        if path.is_file():
+            return State(name, path.read_bytes())
+        return State(name)
+
+    def persist_state(self, state: State) -> None:
+        d = self._dir(state.name)
+        d.mkdir(parents=True, exist_ok=True)
+        tmp = d / (STATE_FILE + ".tmp")
+        tmp.write_bytes(state.to_bytes())
+        tmp.replace(d / STATE_FILE)
+
+    def delete_state(self, name: str) -> None:
+        d = self._dir(name)
+        if d.is_dir():
+            shutil.rmtree(d)
+
+    def state_terraform_config(self, name: str) -> tuple[str, Any]:
+        tfstate = self._dir(name) / TFSTATE_FILE
+        return "terraform.backend.local", {"path": str(tfstate)}
+
+    def __repr__(self) -> str:
+        return f"LocalBackend(root={str(self.root)!r})"

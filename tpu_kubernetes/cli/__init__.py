@@ -1,0 +1,1 @@
+from tpu_kubernetes.cli.main import build_parser, main  # noqa: F401

@@ -1,0 +1,133 @@
+"""CLI command dispatch (the reference's cobra layer).
+
+reference: cmd/root.go (root + config init), cmd/create.go:15-84,
+cmd/destroy.go:15-82, cmd/get.go:15-75, cmd/version.go:13-26 — four commands
+``create|destroy|get|version``, the first three taking one positional
+argument ``manager|cluster|node`` (get: manager|cluster only), plus
+``--config`` and ``--non-interactive`` persistent flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import tpu_kubernetes
+from tpu_kubernetes import create as create_wf
+from tpu_kubernetes import destroy as destroy_wf
+from tpu_kubernetes import get as get_wf
+from tpu_kubernetes.backend import BackendError
+from tpu_kubernetes.config import Config, ConfigError
+from tpu_kubernetes.providers.base import ProviderError
+from tpu_kubernetes.shell import ExecutorError, ValidationError, default_executor
+from tpu_kubernetes.state import StateError
+from tpu_kubernetes.topology import TopologyError
+from tpu_kubernetes.util.backend_prompt import prompt_for_backend
+from tpu_kubernetes.util.prompts import PromptError
+from tpu_kubernetes.utils.trace import TRACER
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpu-kubernetes",
+        description=(
+            "TPU-native multi-cloud Kubernetes provisioning: create and "
+            "destroy cluster managers, clusters (including Cloud TPU pod "
+            "slices), and nodes."
+        ),
+    )
+    parser.add_argument(
+        "--config", metavar="FILE",
+        help="YAML config for silent install (reference: cmd/root.go:39)",
+    )
+    parser.add_argument(
+        "--non-interactive", action="store_true",
+        help="never prompt; missing keys are errors (reference: cmd/root.go:40)",
+    )
+    parser.add_argument(
+        "--set", action="append", default=[], metavar="KEY=VALUE",
+        help="override a config key (highest precedence; repeatable)",
+    )
+    parser.add_argument(
+        "--timing", action="store_true",
+        help="print phase timing JSON to stderr on exit",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    create = sub.add_parser("create", help="create a manager, cluster, or node")
+    create.add_argument("kind", choices=["manager", "cluster", "node"])
+
+    destroy = sub.add_parser("destroy", help="destroy a manager, cluster, or node")
+    destroy.add_argument("kind", choices=["manager", "cluster", "node"])
+
+    get = sub.add_parser("get", help="query a manager or cluster")
+    get.add_argument("kind", choices=["manager", "cluster"])
+
+    sub.add_parser("version", help="print the version")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.command == "version":
+        # reference: cmd/version.go:13-26
+        print(f"tpu-kubernetes v{tpu_kubernetes.__version__}")
+        return 0
+
+    cfg = Config.load(args.config, non_interactive=args.non_interactive)
+    for item in args.set:
+        key, sep, value = item.partition("=")
+        if not sep:
+            print(f"error: --set expects KEY=VALUE, got {item!r}", file=sys.stderr)
+            return 2
+        cfg.set(key, value)
+
+    try:
+        backend = prompt_for_backend(cfg)
+        executor = default_executor()
+        if args.command == "create":
+            print(f"Creating {args.kind}...")  # reference: cmd/create.go:46,53,60
+            if args.kind == "manager":
+                create_wf.new_manager(backend, cfg, executor)
+            elif args.kind == "cluster":
+                create_wf.new_cluster(backend, cfg, executor)
+            else:
+                create_wf.new_node(backend, cfg, executor)
+        elif args.command == "destroy":
+            print(f"Destroying {args.kind}...")
+            if args.kind == "manager":
+                destroy_wf.delete_manager(backend, cfg, executor)
+            elif args.kind == "cluster":
+                destroy_wf.delete_cluster(backend, cfg, executor)
+            else:
+                destroy_wf.delete_node(backend, cfg, executor)
+        elif args.command == "get":
+            out = (
+                get_wf.get_manager(backend, cfg, executor)
+                if args.kind == "manager"
+                else get_wf.get_cluster(backend, cfg, executor)
+            )
+            print(json.dumps(out, indent=2, sort_keys=True))
+    except (
+        ConfigError,
+        ProviderError,
+        BackendError,
+        ExecutorError,
+        PromptError,
+        ValidationError,
+        StateError,
+        TopologyError,
+    ) as e:
+        # reference prints the error then exits 1 (cmd/create.go:48-50)
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        if args.timing:
+            print(TRACER.dump_json(), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+from tpu_kubernetes.config.config import Config, ConfigError  # noqa: F401

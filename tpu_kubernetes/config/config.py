@@ -1,0 +1,174 @@
+"""Precedence config system (the reference's viper analog).
+
+Sources, highest precedence first (reference: cmd/root.go:39-40,48-67):
+
+  1. explicit sets (CLI flags, YAML ``nodes:`` fan-out overrides)
+  2. ``--config`` YAML file
+  3. ``$TPU_K8S_HOME/config.yaml`` (analog of ``$HOME/.triton-kubernetes.yaml``)
+  4. environment variables ``TPU_K8S_<UPPER_SNAKE_KEY>``
+  5. interactive prompt — unless ``non_interactive``, in which case a missing
+     key is a hard error (the universal reference idiom at e.g.
+     create/manager.go:33-55: ``if viper.IsSet(k) {take} else if
+     nonInteractive {error} else {prompt}``).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any, Callable, Sequence
+
+import yaml
+
+from tpu_kubernetes.util.prompts import Prompter
+
+ENV_PREFIX = "TPU_K8S_"
+
+_UNSET = object()
+
+
+class ConfigError(Exception):
+    pass
+
+
+class Config:
+    def __init__(
+        self,
+        values: dict[str, Any] | None = None,
+        non_interactive: bool = False,
+        prompter: Prompter | None = None,
+        env: dict[str, str] | None = None,
+    ):
+        self._overrides: dict[str, Any] = {}
+        self._values: dict[str, Any] = dict(values or {})
+        # answers given at a prompt — cached separately from explicit
+        # overrides so scoped/fresh child configs can drop ONLY these
+        self._prompt_cache: dict[str, Any] = {}
+        self.non_interactive = non_interactive
+        self.prompter = prompter or Prompter()
+        self._env = env if env is not None else os.environ  # type: ignore[assignment]
+
+    @classmethod
+    def load(
+        cls,
+        config_file: str | None = None,
+        non_interactive: bool = False,
+        prompter: Prompter | None = None,
+    ) -> "Config":
+        values: dict[str, Any] = {}
+        home_cfg = Path(os.environ.get("TPU_K8S_HOME", str(Path.home() / ".tpu-kubernetes"))) / "config.yaml"
+        if home_cfg.is_file():
+            values.update(_load_yaml(home_cfg))
+        if config_file:
+            values.update(_load_yaml(Path(config_file)))
+        return cls(values, non_interactive=non_interactive, prompter=prompter)
+
+    # -- mutation ----------------------------------------------------------
+    def set(self, key: str, value: Any) -> None:
+        """Highest-precedence programmatic set (the reference's ``viper.Set``
+        used by the YAML ``nodes:`` fan-out, create/cluster.go:165-217)."""
+        self._overrides[key] = value
+
+    def unset(self, key: str) -> None:
+        self._overrides.pop(key, None)
+
+    # -- lookup ------------------------------------------------------------
+    def is_set(self, key: str) -> bool:
+        return (
+            key in self._overrides
+            or key in self._values
+            or (ENV_PREFIX + key.upper()) in self._env
+            or key in self._prompt_cache
+        )
+
+    def peek(self, key: str, default: Any = None) -> Any:
+        if key in self._overrides:
+            return self._overrides[key]
+        if key in self._values:
+            return self._values[key]
+        env_key = ENV_PREFIX + key.upper()
+        if env_key in self._env:
+            return self._env[env_key]
+        if key in self._prompt_cache:
+            return self._prompt_cache[key]
+        return default
+
+    def get(
+        self,
+        key: str,
+        prompt: str | None = None,
+        default: Any = _UNSET,
+        choices: Sequence[str] | None = None,
+        validate: Callable[[str], str | None] | None = None,
+        secret: bool = False,
+    ) -> Any:
+        """The universal idiom: set → take; else non-interactive → error;
+        else prompt (select if ``choices`` given, text otherwise)."""
+        if self.is_set(key):
+            value = self.peek(key)
+            if choices is not None and value not in choices:
+                raise ConfigError(
+                    f"{key} must be one of {list(choices)}, got {value!r}"
+                )
+            if validate is not None:
+                err = validate(str(value))
+                if err:
+                    raise ConfigError(f"invalid {key}: {err}")
+            return value
+        if self.non_interactive or (default is not _UNSET and prompt is None):
+            # a default with no prompt label means "optional, just take it";
+            # fields that should be offered interactively pass prompt=.
+            if default is not _UNSET:
+                return default
+            raise ConfigError(f"{key} must be specified")
+        label = prompt or key.replace("_", " ")
+        if choices is not None:
+            value = self.prompter.select(label, list(choices))
+        else:
+            value = self.prompter.text(
+                label,
+                default=None if default is _UNSET else str(default),
+                validate=validate,
+                secret=secret,
+            )
+        # cache so repeated gets (and the nodes: fan-out) see one answer
+        self._prompt_cache[key] = value
+        return value
+
+    def get_bool(self, key: str, prompt: str | None = None, default: Any = _UNSET) -> bool:
+        value = self.get(key, prompt=prompt, default=default)
+        if isinstance(value, bool):
+            return value
+        return str(value).strip().lower() in ("1", "true", "yes", "y")
+
+    def get_int(self, key: str, prompt: str | None = None, default: Any = _UNSET) -> int:
+        def _check(s: str) -> str | None:
+            try:
+                int(s)
+                return None
+            except (TypeError, ValueError):
+                return "must be an integer"
+
+        value = self.get(key, prompt=prompt, default=default, validate=_check)
+        return int(value)
+
+    def confirm(self, label: str, force_key: str = "force") -> bool:
+        """Destructive-action gate: ``force``/non-interactive skips the prompt.
+        reference: cmd/destroy.go + util/confirm_prompt.go:10-35."""
+        if self.get_bool(force_key, default=False):
+            return True
+        if self.non_interactive:
+            return True
+        return self.prompter.confirm(label)
+
+
+def _load_yaml(path: Path) -> dict[str, Any]:
+    try:
+        data = yaml.safe_load(path.read_text())
+    except yaml.YAMLError as e:
+        raise ConfigError(f"invalid YAML in {path}: {e}") from e
+    if data is None:
+        return {}
+    if not isinstance(data, dict):
+        raise ConfigError(f"config file {path} must be a YAML mapping")
+    return data

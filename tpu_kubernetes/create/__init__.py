@@ -1,0 +1,8 @@
+from tpu_kubernetes.create.cluster import new_cluster  # noqa: F401
+from tpu_kubernetes.create.manager import new_manager  # noqa: F401
+from tpu_kubernetes.create.node import (  # noqa: F401
+    add_nodes,
+    new_node,
+    select_cluster,
+    select_manager,
+)

@@ -1,0 +1,103 @@
+"""``create cluster`` workflow — the most complex flow (SURVEY §3.2).
+
+reference: create/cluster.go:45-289 (NewCluster): pick manager → pick
+provider → build cluster config → fan out the YAML ``nodes:`` list
+(:165-217) or run the interactive add-node loop (:218-262) → confirm →
+apply → persist.
+
+The reference needs a state re-parse workaround after AddCluster
+(create/cluster.go:146-152, a gabs staleness bug); our State is a live dict,
+so no equivalent exists.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpu_kubernetes.backend import Backend
+from tpu_kubernetes.config import Config
+from tpu_kubernetes.create.node import add_nodes, select_manager
+from tpu_kubernetes.providers import BuildContext, cluster_providers, get_provider
+from tpu_kubernetes.providers.base import ProviderError, prompt_name
+from tpu_kubernetes.shell import Executor, validate_document
+from tpu_kubernetes.shell.outputs import inject_root_outputs
+from tpu_kubernetes.state import State
+from tpu_kubernetes.utils.trace import TRACER
+
+# node-group keys that scope per-group in the YAML nodes: fan-out
+# (reference: create/cluster.go:165-217 — viper.Set per group)
+_NODE_GROUP_PASSTHROUGH_DROP = ("nodes",)
+
+
+def new_cluster(backend: Backend, cfg: Config, executor: Executor) -> State:
+    manager = select_manager(backend, cfg)
+    state = backend.state(manager)
+
+    provider_name = cfg.get(
+        "cluster_cloud_provider",
+        prompt="cloud provider for the cluster",
+        choices=cluster_providers(),
+    )
+    provider = get_provider(provider_name)
+    if provider.build_cluster is None:
+        raise ProviderError(f"provider {provider_name!r} cannot host a cluster")
+
+    name = prompt_name(cfg, "name", "cluster name", state.clusters())
+
+    ctx = BuildContext(cfg=cfg, state=state, name=name)
+    with TRACER.phase("build cluster config", provider=provider_name):
+        config = provider.build_cluster(ctx, {})
+    cluster_key = state.add_cluster(provider_name, name, config)
+
+    hostnames: list[str] = []
+    node_groups = cfg.peek("nodes")
+    if node_groups:
+        # silent-install fan-out (reference: create/cluster.go:165-217)
+        if not isinstance(node_groups, list):
+            raise ProviderError("'nodes' must be a list of node-group mappings")
+        for i, group in enumerate(node_groups):
+            if not isinstance(group, dict):
+                raise ProviderError(f"nodes[{i}] must be a mapping")
+            group_cfg = _scoped_config(cfg, group)
+            hostnames += add_nodes(state, group_cfg, cluster_key)
+    elif not cfg.non_interactive:
+        # interactive add-node loop (reference: create/cluster.go:218-262);
+        # each group gets a fresh scope so answers don't bleed between groups
+        while cfg.prompter.confirm("Add a node group to this cluster?"):
+            hostnames += add_nodes(state, _scoped_config(cfg, {}, fresh=True),
+                                   cluster_key)
+
+    if not cfg.confirm(
+        f"Create cluster {name!r} on {provider_name} with "
+        f"{len(hostnames)} node(s)?"
+    ):
+        raise ProviderError("aborted by user")
+
+    validate_document(state)  # render-time contract check (SURVEY §7 #5)
+    inject_root_outputs(state)  # root forwards so `get` can read module outputs
+    backend.persist_state(state)  # persist intent before apply
+    with TRACER.phase("apply cluster", manager=manager, cluster=name):
+        executor.apply(state)
+    backend.persist_state(state)  # reference: create/cluster.go:284
+    return state
+
+
+def _scoped_config(cfg: Config, group: dict[str, Any], fresh: bool = False) -> Config:
+    """A child Config where one node-group's keys override, without leaking
+    into sibling groups (the reference mutates global viper per group,
+    create/cluster.go:169-184 — a footgun we avoid). ``fresh=True`` drops the
+    parent's cached prompt *answers* so an interactive loop re-prompts per
+    group; explicit --set overrides always carry through."""
+    child = Config(
+        values=dict(cfg._values),
+        non_interactive=cfg.non_interactive,
+        prompter=cfg.prompter,
+        env=cfg._env,
+    )
+    child._overrides = dict(cfg._overrides)
+    if not fresh:
+        child._prompt_cache = dict(cfg._prompt_cache)
+    for k, v in group.items():
+        if k not in _NODE_GROUP_PASSTHROUGH_DROP:
+            child._overrides[k] = v
+    return child

@@ -1,0 +1,59 @@
+"""``create manager`` workflow.
+
+reference: create/manager.go:29-154 (NewManager) — provider select, name
+prompt + dedupe against backend.States(), provider config build, confirm,
+inject terraform backend block, apply, persist.
+
+One deliberate departure (SURVEY §5.3 weakness fix): the state document is
+persisted **before** apply as well as after, so a crash mid-apply never
+leaves the backend ignorant of in-flight infrastructure — retrying the same
+create resumes instead of diverging.
+"""
+
+from __future__ import annotations
+
+from tpu_kubernetes.backend import Backend
+from tpu_kubernetes.config import Config
+from tpu_kubernetes.providers import BuildContext, get_provider, manager_providers
+from tpu_kubernetes.providers.base import ProviderError, prompt_name
+from tpu_kubernetes.shell import Executor, validate_document
+from tpu_kubernetes.shell.outputs import inject_root_outputs
+from tpu_kubernetes.state import State
+from tpu_kubernetes.utils.trace import TRACER
+
+
+def new_manager(backend: Backend, cfg: Config, executor: Executor) -> State:
+    # provider select (reference: create/manager.go:32-55)
+    provider_name = cfg.get(
+        "manager_cloud_provider",
+        prompt="cloud provider for the cluster manager",
+        choices=manager_providers(),
+    )
+    provider = get_provider(provider_name)
+    if provider.build_manager is None:
+        raise ProviderError(f"provider {provider_name!r} cannot host a manager")
+
+    # name + dedupe (reference: create/manager.go:57-101)
+    name = prompt_name(cfg, "name", "cluster manager name", backend.states())
+
+    state = backend.state(name)  # empty doc (reference: create/manager.go:103)
+    ctx = BuildContext(cfg=cfg, state=state, name=name)
+    with TRACER.phase("build manager config", provider=provider_name):
+        config = provider.build_manager(ctx, {})
+    state.set_manager(config)
+
+    # confirm (reference: create/manager.go:127-138)
+    if not cfg.confirm(f"Create cluster manager {name!r} on {provider_name}?"):
+        raise ProviderError("aborted by user")
+
+    # co-locate terraform's own state (reference: create/manager.go:140)
+    path, tf_cfg = backend.state_terraform_config(name)
+    state.set_terraform_backend_config(path, tf_cfg)
+
+    validate_document(state)  # render-time contract check (SURVEY §7 #5)
+    inject_root_outputs(state)  # root forwards so `get` can read module outputs
+    backend.persist_state(state)  # persist intent BEFORE apply (departure)
+    with TRACER.phase("apply manager", manager=name):
+        executor.apply(state)
+    backend.persist_state(state)  # reference: create/manager.go:148
+    return state

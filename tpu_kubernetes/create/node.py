@@ -1,0 +1,127 @@
+"""``create node`` workflow + shared node fan-out helpers.
+
+reference: create/node.go:43-195 (NewNode, newNode provider dispatch),
+:263-344 (count + hostname prefix prompts), :350-380 (hostname series),
+node_gcp.go:344-365 (one module instance added per hostname).
+
+Slice-shaped node groups: for the ``gcp-tpu`` provider one "node" is one TPU
+pod slice (possibly many hosts) — ``node_count`` counts slices. This is the
+deliberate break from the reference's 1-node-=-1-VM model (SURVEY §7 hard
+part #2).
+"""
+
+from __future__ import annotations
+
+import re
+
+from tpu_kubernetes.backend import Backend
+from tpu_kubernetes.config import Config
+from tpu_kubernetes.providers import BuildContext, get_provider
+from tpu_kubernetes.providers.base import ProviderError
+from tpu_kubernetes.shell import Executor, validate_document
+from tpu_kubernetes.shell.outputs import inject_root_outputs
+from tpu_kubernetes.state import State, cluster_key_parts
+from tpu_kubernetes.util import new_hostnames, validate_name
+from tpu_kubernetes.utils.trace import TRACER
+
+
+def select_manager(backend: Backend, cfg: Config) -> str:
+    """Pick an existing cluster manager (reference: create/node.go:54-77)."""
+    names = backend.states()
+    if not names:
+        raise ProviderError("no cluster managers exist yet — create one first")
+    return cfg.get("cluster_manager", prompt="cluster manager", choices=names)
+
+
+def select_cluster(state: State, cfg: Config) -> str:
+    """Pick a cluster from the manager's state, returning its module key
+    (reference: create/node.go:96-135)."""
+    clusters = state.clusters()
+    if not clusters:
+        raise ProviderError(f"manager {state.name!r} has no clusters yet")
+    name = cfg.get("cluster_name", prompt="cluster", choices=sorted(clusters))
+    return clusters[name]
+
+
+def _hostname_from_address(address: str) -> str:
+    """Derive a state-key-safe hostname from an IP/DNS host address. Dots
+    become dashes: module keys must be valid Terraform module names
+    (e.g. 10.0.0.21 → 10-0-0-21)."""
+    return re.sub(r"[^a-zA-Z0-9-]", "-", address)
+
+
+def add_nodes(state: State, cfg: Config, cluster_key: str) -> list[str]:
+    """Build one node config for the cluster's provider and fan it out into
+    per-host (or per-slice) module instances. Returns new hostnames."""
+    parts = cluster_key_parts(cluster_key)
+    if parts is None:
+        raise ProviderError(f"not a cluster key: {cluster_key!r}")
+    provider_name, cluster_name = parts
+    provider = get_provider(provider_name)
+    if provider.build_node is None:
+        raise ProviderError(f"provider {provider_name!r} does not support nodes")
+
+    ctx = BuildContext(cfg=cfg, state=state, name=cluster_name, cluster_key=cluster_key)
+    with TRACER.phase("build node config", provider=provider_name):
+        config = provider.build_node(ctx, {})
+
+    existing = set(state.nodes(cluster_key))
+    hostnames: list[str]
+    if "hosts" in config:
+        # bare-metal style: explicit host addresses, one module per host
+        # (reference: create/node_bare_metal.go:34)
+        addresses = config.pop("hosts")
+        hostnames = []
+        for addr in addresses:
+            hostname = _hostname_from_address(str(addr))
+            if hostname in existing:
+                raise ProviderError(
+                    f"host {addr!r} is already a node of {cluster_name!r}"
+                )
+            per_host = dict(config)
+            per_host["host"] = addr
+            per_host["hostname"] = hostname
+            state.add_node(provider_name, cluster_name, hostname, per_host)
+            hostnames.append(hostname)
+            existing.add(hostname)
+    else:
+        # count + collision-free hostname series
+        # (reference: create/node.go:263-344,350-380)
+        unit = "slice" if provider_name == "gcp-tpu" else "node"
+        count = cfg.get_int(
+            "node_count", prompt=f"number of {unit}s to create", default=1
+        )
+        if count < 1:
+            raise ProviderError("node_count must be >= 1")
+        default_prefix = f"{cluster_name}-{unit}"
+        prefix = cfg.get(
+            "hostname_prefix", prompt=f"{unit} hostname prefix",
+            default=default_prefix, validate=validate_name,
+        )
+        hostnames = new_hostnames(str(prefix), count, existing)
+        for h in hostnames:
+            per_host = dict(config)
+            per_host["hostname"] = h
+            state.add_node(provider_name, cluster_name, h, per_host)
+    return hostnames
+
+
+def new_node(backend: Backend, cfg: Config, executor: Executor) -> list[str]:
+    """Full ``create node`` flow (reference: create/node.go:43-163)."""
+    manager = select_manager(backend, cfg)
+    state = backend.state(manager)
+    cluster_key = select_cluster(state, cfg)
+    hostnames = add_nodes(state, cfg, cluster_key)
+
+    if not cfg.confirm(
+        f"Add {len(hostnames)} node(s) {hostnames} to {cluster_key}?"
+    ):
+        raise ProviderError("aborted by user")
+
+    validate_document(state)  # render-time contract check (SURVEY §7 #5)
+    inject_root_outputs(state)  # root forwards so `get` can read module outputs
+    backend.persist_state(state)  # persist intent before apply
+    with TRACER.phase("apply nodes", manager=manager, count=len(hostnames)):
+        executor.apply(state)
+    backend.persist_state(state)
+    return hostnames

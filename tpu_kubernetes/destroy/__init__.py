@@ -1,0 +1,5 @@
+from tpu_kubernetes.destroy.workflows import (  # noqa: F401
+    delete_cluster,
+    delete_manager,
+    delete_node,
+)

@@ -1,0 +1,1 @@
+from tpu_kubernetes.get.workflows import get_cluster, get_manager  # noqa: F401

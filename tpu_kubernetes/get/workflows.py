@@ -1,0 +1,31 @@
+"""``get manager|cluster`` workflows: query live outputs.
+
+reference: get/manager.go:16-96 and get/cluster.go:17-140 — render the state
+to a temp dir, ``terraform init`` + ``terraform output`` for the module of
+interest, print the result.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpu_kubernetes.backend import Backend
+from tpu_kubernetes.config import Config
+from tpu_kubernetes.create.node import select_cluster, select_manager
+from tpu_kubernetes.shell import Executor
+from tpu_kubernetes.state import MANAGER_KEY
+
+
+def get_manager(backend: Backend, cfg: Config, executor: Executor) -> dict[str, Any]:
+    """reference: get/manager.go:83-92."""
+    manager = select_manager(backend, cfg)
+    state = backend.state(manager)
+    return executor.output(state, MANAGER_KEY)
+
+
+def get_cluster(backend: Backend, cfg: Config, executor: Executor) -> dict[str, Any]:
+    """reference: get/cluster.go:129-138."""
+    manager = select_manager(backend, cfg)
+    state = backend.state(manager)
+    cluster_key = select_cluster(state, cfg)
+    return executor.output(state, cluster_key)

@@ -1,0 +1,2 @@
+"""tpu_kubernetes.ops — part of the in-tree TPU compute stack (being built;
+see __graft_entry__.py and bench.py once present)."""

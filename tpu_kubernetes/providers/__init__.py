@@ -1,0 +1,16 @@
+from tpu_kubernetes.providers.base import (  # noqa: F401
+    BuildContext,
+    Provider,
+    ProviderError,
+    cluster_providers,
+    get_provider,
+    manager_providers,
+    module_source,
+    node_providers,
+    register,
+)
+
+# importing a provider module registers it
+from tpu_kubernetes.providers import baremetal  # noqa: F401,E402
+from tpu_kubernetes.providers import gcp  # noqa: F401,E402
+from tpu_kubernetes.providers import gcp_tpu  # noqa: F401,E402

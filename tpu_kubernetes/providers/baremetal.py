@@ -1,0 +1,66 @@
+"""Bare-metal provider: existing hosts over SSH (optionally via a bastion).
+
+The reference's simplest provider — pure null_resource + remote-exec
+(reference: create/manager_bare_metal.go:20-30, cluster_bare_metal.go:14-19,
+node_bare_metal.go:34; modules bare-metal-rancher*). Also the e2e smoke-test
+path (BASELINE config #1: single-node bare-metal cluster, local backend).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpu_kubernetes.providers.base import (
+    BuildContext,
+    Provider,
+    base_cluster_config,
+    base_manager_config,
+    base_node_config,
+    register,
+)
+
+
+def _ssh_fields(ctx: BuildContext, out: dict[str, Any]) -> None:
+    cfg = ctx.cfg
+    out["ssh_user"] = cfg.get("ssh_user", prompt="SSH user", default="root")
+    out["key_path"] = cfg.get("key_path", prompt="SSH private key path",
+                              default="~/.ssh/id_rsa")
+    bastion = cfg.get("bastion_host", default="")
+    if bastion:
+        out["bastion_host"] = bastion
+
+
+def build_manager(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """reference: create/manager_bare_metal.go:20-30 (host/ssh_user/key_path)."""
+    out = base_manager_config(ctx, "baremetal")
+    out["host"] = ctx.cfg.get("host", prompt="manager host (IP or DNS)")
+    _ssh_fields(ctx, out)
+    return out
+
+
+def build_cluster(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """Adds nothing beyond the base (reference: create/cluster_bare_metal.go:14-16)."""
+    return base_cluster_config(ctx, "baremetal")
+
+
+def build_node(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """reference: create/node_bare_metal.go:34 — takes a ``hosts`` list; one
+    module instance per host is fanned out by the workflow."""
+    out = base_node_config(ctx, "baremetal")
+    hosts = ctx.cfg.get("hosts", prompt="comma-separated host list")
+    if isinstance(hosts, str):
+        hosts = [h.strip() for h in hosts.split(",") if h.strip()]
+    out["hosts"] = hosts
+    _ssh_fields(ctx, out)
+    return out
+
+
+register(
+    Provider(
+        name="baremetal",
+        display="Bare Metal (existing hosts over SSH)",
+        build_manager=build_manager,
+        build_cluster=build_cluster,
+        build_node=build_node,
+    )
+)

@@ -1,0 +1,193 @@
+"""Provider registry + shared (base) module-config builders.
+
+The reference dispatches on provider with hand-written switches repeated in
+three places (create/manager.go:108-122, create/cluster.go:125-141,
+create/node.go:171-195 — its weakest pattern per SURVEY §7). Here providers
+register themselves in a table; workflows look them up.
+
+The **cross-module output contract** (SURVEY §2.3) is encoded here once:
+
+  manager module outputs   : api_url, access_key, secret_key
+    (reference: gcp-rancher/outputs.tf:1-9 — rancher_url/access/secret)
+  cluster module outputs   : registration_token, ca_checksum, + network handles
+    (reference: gcp-rancher-k8s/outputs.tf:1-19)
+  cluster config consumes  : ${module.cluster-manager.api_url} …
+    (reference: create/cluster.go:295-297)
+  node config consumes     : ${module.<cluster_key>.registration_token} …
+    (reference: create/node.go:199-201)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Callable
+
+from tpu_kubernetes.config import Config
+from tpu_kubernetes.state import MANAGER_KEY, State
+from tpu_kubernetes.util import validate_name
+
+# repo-local terraform modules are the default module source; a remote git
+# source can be swapped in via source_url/source_ref
+# (reference: create/cluster.go:300-311, README.md:157-168 SOURCE_URL/SOURCE_REF)
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+TF_MODULES_DIR = _REPO_ROOT / "terraform" / "modules"
+
+K8S_VERSIONS = ["v1.29.4", "v1.30.2", "v1.31.1"]
+NETWORK_PROVIDERS = ["calico", "flannel", "cilium"]
+NODE_ROLES = ["worker", "etcd", "control"]
+
+
+class ProviderError(Exception):
+    pass
+
+
+@dataclass
+class BuildContext:
+    """Everything a provider builder may need."""
+
+    cfg: Config
+    state: State
+    name: str = ""          # name of the manager/cluster being created
+    cluster_key: str = ""   # set for node builds
+
+
+Builder = Callable[[BuildContext, dict[str, Any]], dict[str, Any]]
+
+
+@dataclass
+class Provider:
+    name: str
+    display: str
+    build_manager: Builder | None = None
+    build_cluster: Builder | None = None
+    build_node: Builder | None = None
+
+
+_REGISTRY: dict[str, Provider] = {}
+
+
+def register(provider: Provider) -> Provider:
+    _REGISTRY[provider.name] = provider
+    return provider
+
+
+def get_provider(name: str) -> Provider:
+    if name not in _REGISTRY:
+        raise ProviderError(
+            f"unknown provider {name!r} (known: {sorted(_REGISTRY)})"
+        )
+    return _REGISTRY[name]
+
+
+def manager_providers() -> list[str]:
+    return sorted(n for n, p in _REGISTRY.items() if p.build_manager)
+
+
+def cluster_providers() -> list[str]:
+    return sorted(n for n, p in _REGISTRY.items() if p.build_cluster)
+
+
+def node_providers() -> list[str]:
+    return sorted(n for n, p in _REGISTRY.items() if p.build_node)
+
+
+def module_source(cfg: Config, module_name: str) -> str:
+    """Compose a terraform module source.
+
+    reference: create/manager.go:160-171 composes
+    ``github.com/joyent/triton-kubernetes//terraform/modules/<p>?ref=master``;
+    we default to the in-repo modules (hermetic, no network) and allow the
+    same remote override.
+    """
+    source_url = cfg.peek("source_url") or os.environ.get("TPU_K8S_SOURCE_URL")
+    if source_url:
+        ref = cfg.peek("source_ref") or os.environ.get("TPU_K8S_SOURCE_REF", "main")
+        return f"{source_url}//terraform/modules/{module_name}?ref={ref}"
+    return str(TF_MODULES_DIR / module_name)
+
+
+# -- base configs (the provider-agnostic halves) ---------------------------
+
+def base_manager_config(ctx: BuildContext, provider: str) -> dict[str, Any]:
+    """reference: create/manager.go:16-27,156-183 (baseManagerTerraformConfig)."""
+    cfg = ctx.cfg
+    out: dict[str, Any] = {
+        "source": module_source(cfg, f"{provider}-manager"),
+        "name": ctx.name,
+        "admin_password": cfg.get(
+            "manager_admin_password", prompt="control plane admin password", secret=True
+        ),
+        "server_image": cfg.get("manager_server_image", default=""),
+        "agent_image": cfg.get("manager_agent_image", default=""),
+    }
+    _maybe_private_registry(cfg, out)
+    return out
+
+
+def base_cluster_config(ctx: BuildContext, provider: str) -> dict[str, Any]:
+    """reference: create/cluster.go:24-43,292-399 (baseClusterTerraformConfig)."""
+    cfg = ctx.cfg
+    out: dict[str, Any] = {
+        "source": module_source(cfg, f"{provider}-cluster"),
+        "name": ctx.name,
+        # manager output interpolations (reference: create/cluster.go:295-297)
+        "api_url": f"${{module.{MANAGER_KEY}.api_url}}",
+        "access_key": f"${{module.{MANAGER_KEY}.access_key}}",
+        "secret_key": f"${{module.{MANAGER_KEY}.secret_key}}",
+        # reference: create/cluster.go:349-374
+        "k8s_version": cfg.get(
+            "k8s_version", prompt="kubernetes version",
+            choices=K8S_VERSIONS, default=K8S_VERSIONS[-1],
+        ),
+        # reference: create/cluster.go:377-399 (calico|flannel)
+        "k8s_network_provider": cfg.get(
+            "k8s_network_provider", prompt="network provider",
+            choices=NETWORK_PROVIDERS, default="calico",
+        ),
+    }
+    _maybe_private_registry(cfg, out)
+    return out
+
+
+def base_node_config(ctx: BuildContext, provider: str) -> dict[str, Any]:
+    """reference: create/node.go:19-41,197-261 (baseNodeTerraformConfig +
+    rancherHostLabelsConfig)."""
+    cfg = ctx.cfg
+    role = cfg.get(
+        "node_role", prompt="node role", choices=NODE_ROLES, default="worker"
+    )
+    out: dict[str, Any] = {
+        "source": module_source(cfg, f"{provider}-node"),
+        "api_url": f"${{module.{MANAGER_KEY}.api_url}}",
+        "access_key": f"${{module.{MANAGER_KEY}.access_key}}",
+        "secret_key": f"${{module.{MANAGER_KEY}.secret_key}}",
+        # cluster output interpolations (reference: create/node.go:199-201)
+        "registration_token": f"${{module.{ctx.cluster_key}.registration_token}}",
+        "ca_checksum": f"${{module.{ctx.cluster_key}.ca_checksum}}",
+        "node_role": role,
+    }
+    _maybe_private_registry(cfg, out)
+    return out
+
+
+def _maybe_private_registry(cfg: Config, out: dict[str, Any]) -> None:
+    """reference: create/cluster.go:401-513 — optional private registry creds."""
+    registry = cfg.peek("private_registry")
+    if registry:
+        out["private_registry"] = registry
+        out["private_registry_username"] = cfg.get("private_registry_username")
+        out["private_registry_password"] = cfg.get(
+            "private_registry_password", secret=True
+        )
+
+
+def prompt_name(
+    cfg: Config, key: str, prompt: str, taken: list[str] | dict[str, Any]
+) -> str:
+    """Name prompt + validation + dedupe (reference: create/manager.go:57-101)."""
+    name = cfg.get(key, prompt=prompt, validate=validate_name)
+    if name in taken:
+        raise ProviderError(f"{prompt} {name!r} already exists")
+    return name

@@ -1,0 +1,112 @@
+"""GCP (GCE VM) provider.
+
+reference: create/manager_gcp.go:27-43 (manager config),
+create/cluster_gcp.go:28-34 (cluster config), create/node_gcp.go:24-41,58-66
+(node config + cluster-output interpolations).
+
+The reference validates regions/zones/machine-types/images by calling the
+compute API mid-prompt (create/manager_gcp.go:112-324) — which is why those
+paths are untestable in its suite (SURVEY §4 gap). Here values are taken as
+given (static defaults offered) and validation is left to terraform plan,
+keeping the whole flow hermetic.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+from tpu_kubernetes.providers.base import (
+    BuildContext,
+    Provider,
+    base_cluster_config,
+    base_manager_config,
+    base_node_config,
+    register,
+)
+
+DEFAULT_REGION = "us-central1"
+DEFAULT_ZONE = "us-central1-a"
+DEFAULT_MACHINE_TYPE = "n2-standard-4"
+DEFAULT_IMAGE = "ubuntu-os-cloud/ubuntu-2204-lts"
+
+
+def gcp_project_from_credentials(path: str) -> str | None:
+    """Derive the project id from a service-account JSON file.
+    reference: create/cluster_gcp.go:96-103."""
+    try:
+        data = json.loads(Path(path).expanduser().read_text())
+    except (OSError, ValueError):
+        return None
+    return data.get("project_id")
+
+
+def _gcp_common(ctx: BuildContext, out: dict[str, Any]) -> None:
+    cfg = ctx.cfg
+    creds = cfg.get(
+        "gcp_path_to_credentials", prompt="path to GCP service-account JSON"
+    )
+    out["gcp_path_to_credentials"] = creds
+    derived = gcp_project_from_credentials(creds)
+    if derived is not None:
+        cfg.set("gcp_project_id", cfg.peek("gcp_project_id", derived))
+    out["gcp_project_id"] = cfg.get("gcp_project_id", prompt="GCP project id")
+    out["gcp_compute_region"] = cfg.get(
+        "gcp_compute_region", prompt="GCP compute region", default=DEFAULT_REGION
+    )
+
+
+def build_manager(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """reference: create/manager_gcp.go:27-41."""
+    out = base_manager_config(ctx, "gcp")
+    _gcp_common(ctx, out)
+    cfg = ctx.cfg
+    out["gcp_zone"] = cfg.get("gcp_zone", prompt="GCP zone", default=DEFAULT_ZONE)
+    out["gcp_machine_type"] = cfg.get(
+        "gcp_machine_type", prompt="machine type", default=DEFAULT_MACHINE_TYPE
+    )
+    out["gcp_image"] = cfg.get("gcp_image", prompt="boot image", default=DEFAULT_IMAGE)
+    return out
+
+
+def build_cluster(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """reference: create/cluster_gcp.go:28-34."""
+    out = base_cluster_config(ctx, "gcp")
+    _gcp_common(ctx, out)
+    return out
+
+
+def build_node(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """reference: create/node_gcp.go:24-41; network/firewall-tag interpolated
+    from the cluster module's outputs (:63-66)."""
+    out = base_node_config(ctx, "gcp")
+    _gcp_common(ctx, out)
+    cfg = ctx.cfg
+    out["gcp_zone"] = cfg.get("gcp_zone", prompt="GCP zone", default=DEFAULT_ZONE)
+    out["gcp_machine_type"] = cfg.get(
+        "gcp_machine_type", prompt="machine type", default=DEFAULT_MACHINE_TYPE
+    )
+    out["gcp_image"] = cfg.get("gcp_image", prompt="boot image", default=DEFAULT_IMAGE)
+    disk_gb = int(cfg.get("gcp_disk_size_gb", default=0) or 0)
+    if disk_gb:
+        out["gcp_disk_size_gb"] = disk_gb
+    # cluster module network handles (reference: create/node_gcp.go:63-66)
+    out["gcp_compute_network_name"] = (
+        f"${{module.{ctx.cluster_key}.gcp_compute_network_name}}"
+    )
+    out["gcp_compute_firewall_host_tag"] = (
+        f"${{module.{ctx.cluster_key}.gcp_compute_firewall_host_tag}}"
+    )
+    return out
+
+
+register(
+    Provider(
+        name="gcp",
+        display="Google Cloud Platform (GCE VMs)",
+        build_manager=build_manager,
+        build_cluster=build_cluster,
+        build_node=build_node,
+    )
+)

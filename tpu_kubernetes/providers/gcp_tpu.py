@@ -1,0 +1,145 @@
+"""GCP Cloud TPU provider — the north-star addition (BASELINE.json).
+
+No reference analog exists; this extends the provider switch the way a
+``cluster_gcp_tpu.go`` / ``node_gcp_tpu.go`` pair would extend
+create/cluster.go:125-141 and create/node.go:179-194. Key departures from the
+VM providers:
+
+* A "node" is a **slice**, not a VM (SURVEY §7 hard part #2): one node module
+  instance provisions one v5e/v5p pod slice (``google_tpu_v2_vm``), which may
+  span many hosts. ``node_count`` means number of slices.
+* The accelerator type is parsed into a typed :class:`TpuTopology` and an
+  optionally requested JAX mesh is validated against it at render time —
+  before any quota is consumed.
+* The module emits the ``jax.distributed`` wiring (coordinator address,
+  process count/ids, slice topology) into each host's environment — the TPU
+  analog of the rancher agent's --server/--token/--ca-checksum trio
+  (reference: install_rancher_agent.sh.tpl:44).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpu_kubernetes.providers.base import (
+    BuildContext,
+    Provider,
+    ProviderError,
+    base_cluster_config,
+    base_node_config,
+    register,
+)
+from tpu_kubernetes.providers.gcp import _gcp_common
+from tpu_kubernetes.topology import TopologyError, parse_accelerator_type, validate_mesh
+
+# sensible TPU-VM runtime (software) versions by generation; overridable
+DEFAULT_RUNTIME_VERSIONS = {
+    "v4": "tpu-ubuntu2204-base",
+    "v5e": "v2-alpha-tpuv5-lite",
+    "v5p": "v2-alpha-tpuv5",
+    "v6e": "v2-alpha-tpuv6e",
+}
+DEFAULT_COORDINATOR_PORT = 8476
+# TPU capacity lives in TPU zones, not generic GCE zones (matches the
+# gcp-tpu-node module default)
+DEFAULT_TPU_ZONE = "us-east5-a"
+
+ACCELERATOR_CHOICES = [
+    "v5e-1", "v5e-4", "v5e-8", "v5e-16", "v5e-64", "v5e-256",
+    "v5p-8", "v5p-16", "v5p-32", "v5p-128", "v5p-256",
+    "v6e-4", "v6e-8", "v6e-16", "v6e-256",
+]
+
+
+def build_cluster(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """Cluster envelope: registration with the control plane + the network
+    the slices land in (mirrors gcp cluster, reference:
+    create/cluster_gcp.go:28-34, module gcp-rancher-k8s)."""
+    out = base_cluster_config(ctx, "gcp-tpu")
+    _gcp_common(ctx, out)
+    return out
+
+
+def parse_mesh_shape(spec: str) -> dict[str, int]:
+    """``"data=2,fsdp=8"`` → {"data": 2, "fsdp": 8}."""
+    out: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ProviderError(
+                f"invalid mesh_shape entry {part!r}: expected axis=size"
+            )
+        axis, _, size = part.partition("=")
+        if not size.isdigit():
+            raise ProviderError(f"mesh_shape axis {axis!r} size must be an integer")
+        out[axis.strip()] = int(size)
+    return out
+
+
+def build_node(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    out = base_node_config(ctx, "gcp-tpu")
+    _gcp_common(ctx, out)
+    cfg = ctx.cfg
+
+    accel = cfg.get(
+        "tpu_accelerator_type",
+        prompt="TPU accelerator type",
+        choices=None if cfg.is_set("tpu_accelerator_type") else ACCELERATOR_CHOICES,
+        default="v5e-4",
+    )
+    try:
+        topo = parse_accelerator_type(str(accel))
+    except TopologyError as e:
+        raise ProviderError(str(e)) from e
+
+    mesh_spec = cfg.peek("mesh_shape")
+    if mesh_spec:
+        try:
+            validate_mesh(topo, parse_mesh_shape(str(mesh_spec)))
+        except TopologyError as e:
+            raise ProviderError(str(e)) from e
+
+    out["gcp_zone"] = cfg.get("gcp_zone", prompt="TPU zone", default=DEFAULT_TPU_ZONE)
+    # the API string (v5e → v5litepod-N); canonical form kept alongside
+    out["tpu_accelerator_type"] = topo.api_name
+    out["tpu_topology"] = topo.topology
+    out["tpu_hosts"] = topo.hosts
+    out["tpu_chips"] = topo.chips
+    out["tpu_runtime_version"] = cfg.get(
+        "tpu_runtime_version",
+        default=DEFAULT_RUNTIME_VERSIONS.get(topo.generation, "tpu-ubuntu2204-base"),
+    )
+    out["tpu_coordinator_port"] = int(
+        cfg.get("tpu_coordinator_port", default=DEFAULT_COORDINATOR_PORT)
+    )
+    sched = cfg.get(
+        "tpu_provisioning_model",
+        default="on-demand",
+    )
+    if sched not in ("on-demand", "spot", "reserved"):
+        raise ProviderError(
+            f"tpu_provisioning_model must be on-demand|spot|reserved, got {sched!r}"
+        )
+    out["tpu_provisioning_model"] = sched
+    # cluster module network handles (same contract as gcp nodes,
+    # reference: create/node_gcp.go:63-66)
+    out["gcp_compute_network_name"] = (
+        f"${{module.{ctx.cluster_key}.gcp_compute_network_name}}"
+    )
+    out["gcp_compute_firewall_host_tag"] = (
+        f"${{module.{ctx.cluster_key}.gcp_compute_firewall_host_tag}}"
+    )
+    return out
+
+
+register(
+    Provider(
+        name="gcp-tpu",
+        display="Google Cloud TPU (v5e/v5p/v6e pod slices)",
+        build_manager=None,  # TPU slices join a manager created by gcp/baremetal/…
+        build_cluster=build_cluster,
+        build_node=build_node,
+    )
+)

@@ -1,0 +1,13 @@
+from tpu_kubernetes.shell.validate import (  # noqa: F401
+    ValidationError,
+    validate_document,
+)
+from tpu_kubernetes.shell.executor import (  # noqa: F401
+    Executor,
+    ExecutorError,
+    FakeExecutor,
+    RecordedCall,
+    TerraformExecutor,
+    default_executor,
+    render_to_dir,
+)

@@ -1,0 +1,55 @@
+"""Root-level output forwarding.
+
+Modern terraform cannot read child-module outputs after apply (the
+``terraform output -module`` the reference relied on — get/cluster.go:135 —
+was removed in 0.12). So before every apply the document gets one root output
+``<module_key>__<output>`` forwarding each local module's outputs; after
+apply, ``terraform output -json`` serves them and the executor's
+:meth:`output` filters by module key. Rebuilt from scratch on every call, so
+destroyed modules' forwards disappear with them.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+from tpu_kubernetes.shell.validate import module_outputs
+from tpu_kubernetes.state import State
+
+SEPARATOR = "__"
+
+
+def forward_key(module_key: str, output_name: str) -> str:
+    return f"{module_key}{SEPARATOR}{output_name}"
+
+
+def inject_root_outputs(state: State) -> None:
+    """(Re)build the root ``output`` section from the current module set."""
+    modules = state.get("module", {})
+    forwards: dict[str, Any] = {}
+    if isinstance(modules, dict):
+        for key, config in modules.items():
+            source = config.get("source", "") if isinstance(config, dict) else ""
+            module_dir = Path(source) if source else None
+            if module_dir is None or not module_dir.is_dir():
+                continue
+            for name, sensitive in module_outputs(module_dir).items():
+                block: dict[str, Any] = {"value": f"${{module.{key}.{name}}}"}
+                if sensitive:
+                    block["sensitive"] = True
+                forwards[forward_key(key, name)] = block
+    if forwards:
+        state.set("output", forwards)
+    else:
+        state.delete("output")
+
+
+def filter_module_outputs(
+    root_outputs: dict[str, Any], module_key: str
+) -> dict[str, Any]:
+    """Strip ``<module_key>__`` forwards back to plain output names."""
+    prefix = module_key + SEPARATOR
+    return {
+        k[len(prefix):]: v for k, v in root_outputs.items() if k.startswith(prefix)
+    }

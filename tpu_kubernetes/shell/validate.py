@@ -1,0 +1,144 @@
+"""Render-time schema validation of the state document against the terraform
+modules it references.
+
+The reference's cross-module plumbing (``${module.x.y}`` interpolation
+contracts, SURVEY §2.3) is easy to break silently — a typo'd output name only
+surfaces minutes into a terraform apply. This validator (SURVEY §7 hard part
+#5 fix) checks, before anything is applied:
+
+  1. every config key a module instance carries is a declared variable of its
+     module (catches renamed/typo'd keys),
+  2. every variable without a default is supplied (catches missing config),
+  3. every ``${module.X.y}`` interpolation references an existing module
+     instance X whose module declares output y (catches contract breakage).
+
+Only modules with local directory sources are checked; remote (git) sources
+are skipped — terraform validates those at init time.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+from pathlib import Path
+
+from tpu_kubernetes.state import State
+
+_VARIABLE_RE = re.compile(r'^\s*variable\s+"([^"]+)"', re.MULTILINE)
+_OUTPUT_RE = re.compile(r'^\s*output\s+"([^"]+)"', re.MULTILINE)
+_INTERP_RE = re.compile(r"\$\{module\.([^.}]+)\.([^}]+)\}")
+
+
+class ValidationError(Exception):
+    def __init__(self, errors: list[str]):
+        self.errors = errors
+        super().__init__(
+            "state document failed render-time validation:\n  - "
+            + "\n  - ".join(errors)
+        )
+
+
+@functools.lru_cache(maxsize=256)
+def _parse_module_dir(module_dir: Path) -> tuple[dict[str, bool], dict[str, bool]]:
+    """→ ({variable: has_default}, {output: is_sensitive}) from .tf files.
+    Memoized per directory — a 64-node cluster references the same module 64
+    times per validate/inject pass. Module files are treated as immutable for
+    the life of the process."""
+    variables: dict[str, bool] = {}
+    outputs: dict[str, bool] = {}
+    for tf in sorted(module_dir.glob("*.tf")):
+        text = tf.read_text()
+        for match in _VARIABLE_RE.finditer(text):
+            name = match.group(1)
+            # attribute presence is checked within the block's braces
+            block = _block_after(text, match.end())
+            variables[name] = bool(re.search(r"^\s*default\s*=", block, re.MULTILINE))
+        for match in _OUTPUT_RE.finditer(text):
+            block = _block_after(text, match.end())
+            outputs[match.group(1)] = bool(
+                re.search(r"^\s*sensitive\s*=\s*true", block, re.MULTILINE)
+            )
+    return variables, outputs
+
+
+def module_outputs(module_dir: Path) -> dict[str, bool]:
+    """Public helper: {output_name: is_sensitive} for a local module dir."""
+    _, outputs = _parse_module_dir(module_dir)
+    return outputs
+
+
+def _block_after(text: str, pos: int) -> str:
+    """The {...} block starting at/after pos (brace matching)."""
+    start = text.find("{", pos)
+    if start < 0:
+        return ""
+    depth = 0
+    for i in range(start, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return text[start:i + 1]
+    return text[start:]
+
+
+def validate_document(state: State) -> None:
+    """Raise :class:`ValidationError` on any contract breakage."""
+    modules = state.get("module", {})
+    if not isinstance(modules, dict):
+        return
+    errors: list[str] = []
+
+    for key, config in modules.items():
+        if not isinstance(config, dict):
+            errors.append(f"module.{key}: config is not an object")
+            continue
+        source = config.get("source", "")
+        module_dir = Path(source) if source else None
+        if module_dir is None or not module_dir.is_dir():
+            continue  # remote source — terraform init will fetch + validate
+        variables, _ = _parse_module_dir(module_dir)
+        for cfg_key in config:
+            if cfg_key == "source":
+                continue
+            if cfg_key not in variables:
+                errors.append(
+                    f"module.{key}: {cfg_key!r} is not a variable of "
+                    f"{module_dir.name} (declared: {sorted(variables)})"
+                )
+        for var, has_default in variables.items():
+            if not has_default and var not in config:
+                errors.append(
+                    f"module.{key}: required variable {var!r} of "
+                    f"{module_dir.name} is not set"
+                )
+
+        # interpolation contract check
+        for cfg_key, value in config.items():
+            if not isinstance(value, str):
+                continue
+            for target_key, output in _INTERP_RE.findall(value):
+                target = modules.get(target_key)
+                if target is None:
+                    errors.append(
+                        f"module.{key}.{cfg_key}: references missing module "
+                        f"{target_key!r}"
+                    )
+                    continue
+                target_source = (
+                    target.get("source", "") if isinstance(target, dict) else ""
+                )
+                target_dir = Path(target_source) if target_source else None
+                if target_dir is None or not target_dir.is_dir():
+                    continue
+                _, outputs = _parse_module_dir(target_dir)
+                if output not in outputs:
+                    errors.append(
+                        f"module.{key}.{cfg_key}: module {target_key!r} "
+                        f"({target_dir.name}) declares no output {output!r} "
+                        f"(declared: {sorted(outputs)})"
+                    )
+
+    if errors:
+        raise ValidationError(errors)

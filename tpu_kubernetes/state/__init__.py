@@ -1,0 +1,7 @@
+from tpu_kubernetes.state.document import (  # noqa: F401
+    MANAGER_KEY,
+    State,
+    StateError,
+    cluster_key_parts,
+    node_key_parts,
+)

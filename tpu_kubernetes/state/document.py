@@ -1,0 +1,203 @@
+"""Named Terraform-JSON state document.
+
+The whole deployment (manager + clusters + nodes) for one cluster manager is a
+single Terraform JSON document. Workflow code mutates it through this wrapper
+and the executor applies it. Mirrors the reference's gabs-backed document
+(reference: state/state.go:10-147) with the same key naming scheme:
+
+  module."cluster-manager"                      — the manager module
+  module."cluster_{provider}_{name}"            — one cluster
+  module."node_{provider}_{cluster}_{hostname}" — one node
+
+(reference: state/state.go:55-77). Cluster and node *names* are validated to
+never contain ``_`` so prefix-scan parsing is unambiguous (the reference's
+split-on-underscore parsing at state/state.go:149-160 silently breaks on such
+names; we reject them at the door instead — see util/names.py), and never
+contain ``.`` because module keys must be valid Terraform module names.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import re
+from typing import Any, Iterator
+
+MANAGER_KEY = "cluster-manager"
+
+_NAME_RE = re.compile(r"^[a-zA-Z0-9][a-zA-Z0-9-]*$")
+
+
+class StateError(Exception):
+    pass
+
+
+def _check_name(kind: str, name: str) -> None:
+    if not _NAME_RE.match(name):
+        raise StateError(
+            f"invalid {kind} name {name!r}: must match [a-zA-Z0-9][a-zA-Z0-9-]* "
+            "(underscores are key separators; dots are invalid in Terraform "
+            "module names)"
+        )
+
+
+class State:
+    """A named, mutable Terraform-JSON document.
+
+    reference: state/state.go (New :20, Get :~30, SetManager :36,
+    SetTerraformBackendConfig :45, AddCluster :55, AddNode :65, Delete :79,
+    Bytes :88, Clusters :94, Nodes :117).
+    """
+
+    def __init__(self, name: str, data: bytes | str | dict[str, Any] | None = None):
+        self.name = name
+        if data is None or data == b"" or data == "":
+            self._doc: dict[str, Any] = {}
+        elif isinstance(data, dict):
+            self._doc = copy.deepcopy(data)
+        else:
+            self._doc = json.loads(data)
+            if not isinstance(self._doc, dict):
+                raise StateError(f"state document for {name!r} is not a JSON object")
+
+    # -- dotted-path access ------------------------------------------------
+    def get(self, path: str, default: Any = None) -> Any:
+        node: Any = self._doc
+        for part in path.split("."):
+            if not isinstance(node, dict) or part not in node:
+                return default
+            node = node[part]
+        return node
+
+    def set(self, path: str, value: Any) -> None:
+        parts = path.split(".")
+        node = self._doc
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+            if not isinstance(node, dict):
+                raise StateError(f"path {path!r} collides with non-object value")
+        node[parts[-1]] = value
+
+    def delete(self, path: str) -> None:
+        """Delete a path (no-op if absent). reference: state/state.go:79-86."""
+        parts = path.split(".")
+        node: Any = self._doc
+        for part in parts[:-1]:
+            if not isinstance(node, dict) or part not in node:
+                return
+            node = node[part]
+        if isinstance(node, dict):
+            node.pop(parts[-1], None)
+
+    # -- module access -----------------------------------------------------
+    # Module keys are plain dict keys, never dotted paths (robust regardless
+    # of key content).
+    def set_module(self, key: str, config: dict[str, Any]) -> None:
+        self._doc.setdefault("module", {})[key] = config
+
+    def module(self, key: str) -> dict[str, Any] | None:
+        modules = self.get("module", {})
+        return modules.get(key) if isinstance(modules, dict) else None
+
+    def delete_module(self, key: str) -> None:
+        modules = self.get("module")
+        if isinstance(modules, dict):
+            modules.pop(key, None)
+
+    # -- manager / backend -------------------------------------------------
+    def set_manager(self, config: dict[str, Any]) -> str:
+        """Install the manager module config. reference: state/state.go:36-43."""
+        key = MANAGER_KEY
+        self.set_module(key, config)
+        return key
+
+    def manager(self) -> dict[str, Any] | None:
+        return self.module(MANAGER_KEY)
+
+    def set_terraform_backend_config(self, path: str, config: Any) -> None:
+        """Inject the ``terraform.backend.*`` block so terraform's own tfstate
+        is co-located with this document. reference: state/state.go:45-53,
+        backend/backend.go:24-26."""
+        self.set(path, config)
+
+    # -- clusters ----------------------------------------------------------
+    def add_cluster(self, provider: str, name: str, config: dict[str, Any]) -> str:
+        """reference: state/state.go:55-62."""
+        _check_name("provider", provider)
+        _check_name("cluster", name)
+        key = f"cluster_{provider}_{name}"
+        self.set_module(key, config)
+        return key
+
+    def add_node(
+        self, provider: str, cluster_name: str, hostname: str, config: dict[str, Any]
+    ) -> str:
+        """reference: state/state.go:65-77."""
+        _check_name("provider", provider)
+        _check_name("cluster", cluster_name)
+        _check_name("hostname", hostname)
+        key = f"node_{provider}_{cluster_name}_{hostname}"
+        self.set_module(key, config)
+        return key
+
+    def _module_keys(self) -> Iterator[str]:
+        modules = self.get("module", {})
+        if isinstance(modules, dict):
+            yield from modules.keys()
+
+    def clusters(self) -> dict[str, str]:
+        """Map of cluster name → module key, by prefix scan.
+        reference: state/state.go:94-115."""
+        out: dict[str, str] = {}
+        for key in self._module_keys():
+            parts = cluster_key_parts(key)
+            if parts is not None:
+                out[parts[1]] = key
+        return out
+
+    def nodes(self, cluster_key: str) -> dict[str, str]:
+        """Map of hostname → module key for one cluster.
+        reference: state/state.go:117-147."""
+        parts = cluster_key_parts(cluster_key)
+        if parts is None:
+            raise StateError(f"not a cluster key: {cluster_key!r}")
+        provider, cluster_name = parts
+        prefix = f"node_{provider}_{cluster_name}_"
+        out: dict[str, str] = {}
+        for key in self._module_keys():
+            if key.startswith(prefix):
+                out[key[len(prefix):]] = key
+        return out
+
+    # -- serialization -----------------------------------------------------
+    def to_bytes(self) -> bytes:
+        """reference: state/state.go:88-92."""
+        return json.dumps(self._doc, indent=2, sort_keys=True).encode()
+
+    def to_dict(self) -> dict[str, Any]:
+        return copy.deepcopy(self._doc)
+
+
+def cluster_key_parts(key: str) -> tuple[str, str] | None:
+    """Parse ``cluster_{provider}_{name}`` → (provider, name), else None.
+    reference: state/state.go:149-160."""
+    if not key.startswith("cluster_"):
+        return None
+    rest = key[len("cluster_"):]
+    if "_" not in rest:
+        return None
+    provider, name = rest.split("_", 1)
+    if not provider or not name or "_" in name:
+        return None
+    return provider, name
+
+
+def node_key_parts(key: str) -> tuple[str, str, str] | None:
+    """Parse ``node_{provider}_{cluster}_{hostname}`` → parts, else None."""
+    if not key.startswith("node_"):
+        return None
+    rest = key[len("node_"):]
+    pieces = rest.split("_")
+    if len(pieces) != 3 or not all(pieces):
+        return None
+    return pieces[0], pieces[1], pieces[2]

@@ -1,0 +1,7 @@
+from tpu_kubernetes.topology.tpu import (  # noqa: F401
+    TopologyError,
+    TpuTopology,
+    parse_accelerator_type,
+    slice_host_env,
+    validate_mesh,
+)

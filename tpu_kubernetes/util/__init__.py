@@ -1,0 +1,6 @@
+from tpu_kubernetes.util.names import new_hostnames, validate_name  # noqa: F401
+from tpu_kubernetes.util.prompts import (  # noqa: F401
+    PromptError,
+    Prompter,
+    ScriptedPrompter,
+)

@@ -1,0 +1,29 @@
+"""Backend selection (the reference's PromptForBackend).
+
+reference: util/backend_prompt.go:18-168 — choose Local or Manta (with full
+Manta credential prompting). Ours: local or gcs (the Manta analog).
+"""
+
+from __future__ import annotations
+
+from tpu_kubernetes.backend import Backend, LocalBackend
+from tpu_kubernetes.config import Config
+
+BACKEND_PROVIDERS = ["local", "gcs"]
+
+
+def prompt_for_backend(cfg: Config) -> Backend:
+    provider = cfg.get(
+        "backend_provider",
+        prompt="state backend",
+        choices=BACKEND_PROVIDERS,
+        default="local",
+    )
+    if provider == "local":
+        return LocalBackend()
+    if provider == "gcs":
+        from tpu_kubernetes.backend import new_gcs_backend
+
+        bucket = cfg.get("gcs_bucket", prompt="GCS bucket for state")
+        return new_gcs_backend(str(bucket))
+    raise ValueError(f"unknown backend provider {provider!r}")

@@ -1,0 +1,46 @@
+"""Name validation and collision-free hostname series generation.
+
+reference: create/node.go:350-380 (``getNewHostnames``) — given a hostname
+prefix and the set of existing hostnames in the cluster, produce the next N
+names ``{prefix}-{i}`` without colliding, filling gaps from 1 upward.
+"""
+
+from __future__ import annotations
+
+import re
+
+NAME_RE = re.compile(r"^[a-zA-Z0-9][a-zA-Z0-9-]*$")
+
+
+def validate_name(value: str) -> str | None:
+    """Validator usable with Config.get / Prompter.text: returns an error
+    message or None. Underscores are forbidden because they are the state-doc
+    key separators (see state/document.py); dots are forbidden because module
+    keys must be valid Terraform module names (letters/digits/underscores/
+    dashes only)."""
+    if not value:
+        return "a name is required"
+    if not NAME_RE.match(value):
+        return (
+            "names must start with an alphanumeric and contain only "
+            "alphanumerics and '-'"
+        )
+    return None
+
+
+def new_hostnames(prefix: str, count: int, existing: set[str] | list[str]) -> list[str]:
+    """Next ``count`` collision-free hostnames ``{prefix}-{i}``.
+
+    reference: create/node.go:350-380 — indexes start at 1 and skip any index
+    already taken (whether created by us earlier or by hand).
+    """
+    taken = set(existing)
+    out: list[str] = []
+    i = 1
+    while len(out) < count:
+        candidate = f"{prefix}-{i}"
+        if candidate not in taken:
+            out.append(candidate)
+            taken.add(candidate)
+        i += 1
+    return out

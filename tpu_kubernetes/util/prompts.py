@@ -1,0 +1,112 @@
+"""Interactive prompt utilities (the reference's promptui analog).
+
+The reference uses promptui for free-text and select prompts
+(e.g. create/manager.go:33-55) and a dedicated yes/no confirmation
+(reference: util/confirm_prompt.go:10-35). Here a single injectable
+:class:`Prompter` carries all three so workflow code is testable with
+:class:`ScriptedPrompter`.
+"""
+
+from __future__ import annotations
+
+import getpass
+import sys
+from typing import Callable, Sequence
+
+
+class PromptError(Exception):
+    pass
+
+
+class Prompter:
+    """Terminal prompter reading from stdin."""
+
+    def text(
+        self,
+        label: str,
+        default: str | None = None,
+        validate: Callable[[str], str | None] | None = None,
+        secret: bool = False,
+    ) -> str:
+        while True:
+            suffix = f" [{default}]" if default not in (None, "") else ""
+            try:
+                if secret:
+                    raw = getpass.getpass(f"{label}{suffix}: ")
+                else:
+                    raw = input(f"{label}{suffix}: ")
+            except EOFError as e:
+                raise PromptError(f"stdin closed while prompting for {label!r}") from e
+            value = raw.strip() or (default or "")
+            if validate is not None:
+                err = validate(value)
+                if err:
+                    print(f"  ✗ {err}", file=sys.stderr)
+                    continue
+            if value:
+                return value
+            print("  ✗ a value is required", file=sys.stderr)
+
+    def select(self, label: str, options: Sequence[str]) -> str:
+        if not options:
+            raise PromptError(f"no options available for {label!r}")
+        print(f"{label}:")
+        for i, opt in enumerate(options, 1):
+            print(f"  {i}. {opt}")
+        while True:
+            try:
+                raw = input(f"Select [1-{len(options)}]: ").strip()
+            except EOFError as e:
+                raise PromptError(f"stdin closed while prompting for {label!r}") from e
+            if raw.isdigit() and 1 <= int(raw) <= len(options):
+                return options[int(raw) - 1]
+            if raw in options:
+                return raw
+            print("  ✗ invalid selection", file=sys.stderr)
+
+    def confirm(self, label: str) -> bool:
+        """Yes/no gate. reference: util/confirm_prompt.go:10-35."""
+        try:
+            raw = input(f"{label} (yes/no): ").strip().lower()
+        except EOFError:
+            return False
+        return raw in ("y", "yes")
+
+
+class ScriptedPrompter(Prompter):
+    """Deterministic prompter for tests: answers come from a queue; running
+    out of answers is a hard error (mirrors how reference tests force the
+    non-interactive error path, e.g. destroy/cluster_test.go:19-100)."""
+
+    def __init__(self, answers: Sequence[str] = (), confirm_answers: Sequence[bool] = ()):
+        self.answers = list(answers)
+        self.confirm_answers = list(confirm_answers)
+        self.log: list[str] = []
+
+    def _pop(self, label: str) -> str:
+        if not self.answers:
+            raise PromptError(f"unexpected prompt: {label!r}")
+        self.log.append(label)
+        return self.answers.pop(0)
+
+    def text(self, label, default=None, validate=None, secret=False):  # type: ignore[override]
+        value = self._pop(label) or (default or "")
+        if validate is not None:
+            err = validate(value)
+            if err:
+                raise PromptError(f"scripted answer for {label!r} invalid: {err}")
+        return value
+
+    def select(self, label, options):  # type: ignore[override]
+        value = self._pop(label)
+        if value not in options:
+            raise PromptError(
+                f"scripted answer {value!r} for {label!r} not in options {list(options)}"
+            )
+        return value
+
+    def confirm(self, label):  # type: ignore[override]
+        self.log.append(label)
+        if not self.confirm_answers:
+            return False
+        return self.confirm_answers.pop(0)

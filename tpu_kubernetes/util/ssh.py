@@ -1,0 +1,59 @@
+"""SSH key utilities.
+
+reference: util/ssh_utils.go:13-42 — derive the MD5 public-key fingerprint
+(colon-separated hex, the Triton/Joyent API format) from a private key file,
+retrying with a passphrase prompt if the key is encrypted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from pathlib import Path
+
+
+class SSHKeyError(Exception):
+    pass
+
+
+def public_key_md5_fingerprint(
+    private_key_path: str, passphrase: str | None = None
+) -> str:
+    """MD5 fingerprint aa:bb:... of the public half of an SSH private key.
+
+    reference: util/ssh_utils.go:13-42 (including the encrypted-key retry —
+    callers catch :class:`SSHKeyNeedsPassphrase` and re-call with a
+    passphrase).
+    """
+    try:
+        from cryptography.hazmat.primitives import serialization
+    except ImportError as e:  # pragma: no cover
+        raise SSHKeyError("cryptography package unavailable") from e
+
+    data = Path(private_key_path).expanduser().read_bytes()
+    pw = passphrase.encode() if passphrase else None
+    try:
+        key = serialization.load_ssh_private_key(data, password=pw)
+    except ValueError:
+        try:
+            key = serialization.load_pem_private_key(data, password=pw)
+        except TypeError as e:
+            raise SSHKeyNeedsPassphrase(str(private_key_path)) from e
+        except ValueError as e:
+            raise SSHKeyError(f"cannot parse private key {private_key_path}: {e}") from e
+    except TypeError as e:
+        raise SSHKeyNeedsPassphrase(str(private_key_path)) from e
+
+    pub = key.public_key().public_bytes(
+        encoding=serialization.Encoding.OpenSSH,
+        format=serialization.PublicFormat.OpenSSH,
+    )
+    # OpenSSH format: "<type> <base64>"; fingerprint is md5 of the raw blob.
+    import base64
+
+    blob = base64.b64decode(pub.split()[1])
+    digest = hashlib.md5(blob).hexdigest()
+    return ":".join(digest[i:i + 2] for i in range(0, len(digest), 2))
+
+
+class SSHKeyNeedsPassphrase(SSHKeyError):
+    """Raised when the key is encrypted and no/wrong passphrase was given."""

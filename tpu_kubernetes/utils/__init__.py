@@ -1,0 +1,1 @@
+from tpu_kubernetes.utils.trace import TRACER, Span, Tracer  # noqa: F401

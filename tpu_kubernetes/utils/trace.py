@@ -1,0 +1,60 @@
+"""Structured step timing.
+
+The reference has no tracing at all — only fmt.Println progress lines
+(SURVEY §5.1; reference: cmd/create.go:46,53,60). Since the north-star metric
+is create→first-train-step latency, every workflow phase here runs under a
+:func:`phase` timer and the spans are retrievable/dumpable as JSON.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    end: float | None = None
+    meta: dict = field(default_factory=dict)
+
+    @property
+    def seconds(self) -> float:
+        return (self.end if self.end is not None else time.monotonic()) - self.start
+
+
+class Tracer:
+    def __init__(self, stream=None, enabled: bool = True):
+        self.spans: list[Span] = []
+        self.stream = stream if stream is not None else sys.stderr
+        self.enabled = enabled
+
+    @contextlib.contextmanager
+    def phase(self, name: str, **meta):
+        span = Span(name=name, start=time.monotonic(), meta=dict(meta))
+        self.spans.append(span)
+        if self.enabled:
+            print(f"[tpu-k8s] ▶ {name}", file=self.stream)
+        try:
+            yield span
+        finally:
+            span.end = time.monotonic()
+            if self.enabled:
+                print(f"[tpu-k8s] ✓ {name} ({span.seconds:.1f}s)", file=self.stream)
+
+    def report(self) -> list[dict]:
+        return [
+            {"phase": s.name, "seconds": round(s.seconds, 3), **s.meta}
+            for s in self.spans
+        ]
+
+    def dump_json(self) -> str:
+        return json.dumps(self.report())
+
+
+# module-level default tracer; workflows use this unless handed another
+TRACER = Tracer()
