@@ -1,0 +1,148 @@
+"""Benchmark: training throughput + MFU of the in-tree Llama stack on the
+local accelerator (the driver runs this on one real TPU chip).
+
+Prints exactly ONE JSON line to stdout:
+  {"metric": "mfu", "value": ..., "unit": "fraction", "vs_baseline": ...,
+   "tokens_per_sec_per_chip": ..., ...}
+
+``vs_baseline`` is measured MFU / 0.40 — the north-star target is ≥40% MFU
+(BASELINE.md; the reference publishes no numbers of its own).
+
+Env knobs: BENCH_MODEL (default llama-1b), BENCH_BATCH, BENCH_SEQ,
+BENCH_STEPS, BENCH_WARMUP.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+
+def log(*args):
+    print("[bench]", *args, file=sys.stderr, flush=True)
+
+
+# peak dense bf16 TFLOP/s per chip, by device_kind substring
+PEAK_TFLOPS = [
+    ("v6 lite", 918.0),
+    ("v6e", 918.0),
+    ("v5p", 459.0),
+    ("v5 lite", 197.0),
+    ("v5litepod", 197.0),
+    ("v5e", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+]
+
+
+def peak_flops_per_chip(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, tflops in PEAK_TFLOPS:
+        if key in kind:
+            return tflops * 1e12
+    return None
+
+
+def model_flops_per_token(cfg, n_params: int, seq: int) -> float:
+    """Standard training-FLOPs estimate: 6N for the dense path plus
+    12·L·d_model·seq for attention scores/values (causal halves it)."""
+    attn = 12 * cfg.n_layers * cfg.d_model * seq * 0.5
+    return 6.0 * n_params + attn
+
+
+def main() -> None:
+    import jax
+
+    # honor an explicit JAX_PLATFORMS even where a sitecustomize forces a
+    # tunneled TPU platform (local CPU smoke runs)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from tpu_kubernetes.models import CONFIGS, param_count
+    from tpu_kubernetes.parallel import initialize
+    from tpu_kubernetes.train import (
+        TrainConfig,
+        init_state,
+        synthetic_batches,
+        train_step,
+    )
+
+    initialize()  # no-op on single host; assembles the slice on multi-host
+
+    model_name = os.environ.get("BENCH_MODEL", "llama-1b")
+    cfg = CONFIGS[model_name]
+    batch = int(os.environ.get("BENCH_BATCH", "4"))
+    seq = int(os.environ.get("BENCH_SEQ", str(min(cfg.max_seq, 2048))))
+    steps = int(os.environ.get("BENCH_STEPS", "5"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "2"))
+    if seq != cfg.max_seq:
+        from dataclasses import replace
+
+        cfg = replace(cfg, max_seq=seq)
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    log(f"backend={jax.default_backend()} devices={n_chips} "
+        f"kind={getattr(devices[0], 'device_kind', '?')}")
+    log(f"model={model_name} batch={batch} seq={seq}")
+
+    tc = TrainConfig(warmup_steps=10)
+    t0 = time.perf_counter()
+    with jax.default_device(devices[0]):
+        state = init_state(jax.random.PRNGKey(0), cfg, tc)
+        n_params = param_count(state["params"])
+        log(f"params={n_params/1e6:.1f}M init={time.perf_counter()-t0:.1f}s")
+
+        step = jax.jit(
+            functools.partial(train_step, cfg=cfg, tc=tc), donate_argnums=(0,)
+        )
+        batches = synthetic_batches(cfg.vocab_size, batch, seq)
+
+        t0 = time.perf_counter()
+        for i in range(warmup):
+            state, loss = step(state, next(batches))
+        jax.block_until_ready(loss)
+        log(f"warmup+compile={time.perf_counter()-t0:.1f}s loss={float(loss):.3f}")
+
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, loss = step(state, next(batches))
+        jax.block_until_ready(loss)
+        elapsed = time.perf_counter() - t0
+
+    step_time = elapsed / steps
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step / step_time
+    tokens_per_sec_per_chip = tokens_per_sec / n_chips
+
+    flops_per_token = model_flops_per_token(cfg, n_params, seq)
+    achieved_flops = tokens_per_sec * flops_per_token
+    peak = peak_flops_per_chip(devices[0])
+    mfu = achieved_flops / (peak * n_chips) if peak else 0.0
+
+    log(f"step_time={step_time*1e3:.1f}ms tokens/s/chip={tokens_per_sec_per_chip:.0f} "
+        f"mfu={mfu:.3f} (peak={'?' if not peak else f'{peak/1e12:.0f}T'})")
+
+    print(json.dumps({
+        "metric": "mfu",
+        "value": round(mfu, 4),
+        "unit": "fraction",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "tokens_per_sec_per_chip": round(tokens_per_sec_per_chip, 1),
+        "step_time_ms": round(step_time * 1e3, 1),
+        "model": model_name,
+        "params_millions": round(n_params / 1e6, 1),
+        "batch": batch,
+        "seq": seq,
+        "chips": n_chips,
+        "device_kind": getattr(devices[0], "device_kind", "unknown"),
+        "final_loss": round(float(loss), 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -7,12 +7,19 @@ imported anywhere in the process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU — the dev image may preset JAX_PLATFORMS to a tunneled TPU (and a
+# sitecustomize re-forces it at jax import), but the suite must be hermetic
+# and runs shardings on a virtual 8-device mesh
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

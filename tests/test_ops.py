@@ -1,0 +1,155 @@
+"""Numerical tests for ops: flash attention (pallas interpret mode) vs the
+XLA reference, RMSNorm, RoPE."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_kubernetes.ops import (
+    apply_rope,
+    attention_reference,
+    flash_attention,
+    rms_norm,
+    rope_frequencies,
+)
+
+B, H, S, D = 2, 3, 256, 64
+
+
+def qkv(seed=0, seq=S):
+    rng = np.random.default_rng(seed)
+    shape = (B, H, seq, D)
+    q = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    k = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    v = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_forward_matches_reference(causal):
+    q, k, v = qkv()
+    ref = attention_reference(q, k, v, causal=causal)
+    out = flash_attention(
+        q, k, v, causal=causal, block_q=128, block_k=128, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_forward_uneven_blocks():
+    q, k, v = qkv(seq=256)
+    ref = attention_reference(q, k, v, causal=True)
+    out = flash_attention(
+        q, k, v, causal=True, block_q=64, block_k=128, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_gradients_match_reference(causal):
+    q, k, v = qkv(seed=1)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(
+            q, k, v, causal=causal, block_q=64, block_k=64, interpret=True
+        )
+        return jnp.sum(out ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_flash_rejects_indivisible_seq():
+    q, k, v = qkv()
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, k, v, block_q=96, block_k=100, interpret=True)
+
+
+def test_dispatcher_uses_reference_on_cpu():
+    q, k, v = qkv()
+    out = flash_attention(q, k, v)  # auto: CPU → reference path
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_rms_norm_matches_formula():
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((4, 8, 16)), jnp.float32)
+    w = jnp.ones((16,)) * 2.0
+    out = rms_norm(x, w)
+    expected = x / np.sqrt(np.mean(np.square(np.asarray(x)), -1, keepdims=True) + 1e-6) * 2.0
+    np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5)
+
+
+def test_rope_preserves_norm_and_is_position_dependent():
+    x = jnp.asarray(
+        np.random.default_rng(3).standard_normal((1, 2, 8, 32)), jnp.float32
+    )
+    cos, sin = rope_frequencies(32, 16)
+    out = apply_rope(x, cos, sin)
+    # rotation preserves the norm of each (x1, x2) pair
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1),
+        rtol=1e-5,
+    )
+    # position 0 is the identity rotation
+    np.testing.assert_allclose(
+        np.asarray(out[:, :, 0]), np.asarray(x[:, :, 0]), atol=1e-6
+    )
+    # later positions are genuinely rotated
+    assert not np.allclose(np.asarray(out[:, :, 5]), np.asarray(x[:, :, 5]))
+
+
+def test_rope_relative_property():
+    """Attention scores under RoPE depend only on relative position."""
+    d = 16
+    cos, sin = rope_frequencies(d, 32)
+    rng = np.random.default_rng(4)
+    qv = jnp.asarray(rng.standard_normal((1, 1, 1, d)), jnp.float32)
+    kv = jnp.asarray(rng.standard_normal((1, 1, 1, d)), jnp.float32)
+
+    def score(qpos, kpos):
+        qr = apply_rope(qv, cos, sin, positions=jnp.array([qpos]))
+        kr = apply_rope(kv, cos, sin, positions=jnp.array([kpos]))
+        return float(jnp.sum(qr * kr))
+
+    assert math.isclose(score(3, 1), score(10, 8), rel_tol=1e-4)
+
+
+@pytest.mark.parametrize("seq_q,seq_k", [(64, 256), (128, 256)])
+def test_flash_cross_length_causal_matches_reference(seq_q, seq_k):
+    """Bottom-right-aligned causal mask for seq_q != seq_k (decode-style):
+    the pallas path must agree with the reference (regression)."""
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.standard_normal((1, 2, seq_q, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 2, seq_k, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 2, seq_k, D)), jnp.float32)
+    ref = attention_reference(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=64,
+                                       block_k=64, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    g_f = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_f, g_r, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name} mismatch")

@@ -1,2 +1,11 @@
-"""tpu_kubernetes.models — part of the in-tree TPU compute stack (being built;
-see __graft_entry__.py and bench.py once present)."""
+"""tpu_kubernetes.models — model family for the in-tree training stack."""
+
+from tpu_kubernetes.models.llama import (  # noqa: F401
+    CONFIGS,
+    ModelConfig,
+    forward,
+    init_params,
+    logical_axes,
+    loss_fn,
+    param_count,
+)

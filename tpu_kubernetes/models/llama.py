@@ -1,0 +1,191 @@
+"""LLaMA-family decoder-only transformer, functional JAX.
+
+The flagship model of the in-tree training stack (the framework's
+MaxText-analog example job — BASELINE.json north star trains Llama-7B on a
+v5p-32 slice). TPU-first design decisions:
+
+* Pure functional params-pytree + ``lax.scan`` over stacked layer params:
+  one trace/compile of the block regardless of depth, the standard recipe
+  for fast XLA compiles at 32-80 layers.
+* Every parameter leaf carries a **logical axis** annotation (a parallel
+  pytree of tuples) which parallel/sharding.py maps onto the device mesh
+  (fsdp/tensor/data axes) — sharding lives beside the model but is not
+  entangled with it.
+* bfloat16 activations/weights with float32 RMSNorm and attention
+  accumulation (ops/), f32 master copy optional at the optimizer level.
+* GQA: n_kv_heads ≤ n_heads; KV heads are repeated just before the
+  attention op (a broadcast XLA folds into the kernel's operand layout).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpu_kubernetes.ops import apply_rope, flash_attention, rms_norm, rope_frequencies
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11008
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    # attention implementation knobs (forwarded to ops.flash_attention)
+    attn_block_q: int = 128
+    attn_block_k: int = 128
+    use_pallas: bool | None = None
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# -- presets (parameter counts approximate the named family members) -------
+CONFIGS: dict[str, ModelConfig] = {
+    "llama-test": ModelConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=128, remat=False,
+    ),
+    "llama-125m": ModelConfig(
+        vocab_size=32000, d_model=768, n_layers=12, n_heads=12, n_kv_heads=12,
+        d_ff=2048, max_seq=2048,
+    ),
+    "llama-1b": ModelConfig(
+        vocab_size=32000, d_model=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+        d_ff=5632, max_seq=2048,
+    ),
+    "llama-7b": ModelConfig(),  # the defaults above are Llama-2-7B
+    "llama-70b": ModelConfig(
+        d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8, d_ff=28672,
+        max_seq=4096,
+    ),
+}
+
+
+# -- parameter init + logical axis annotations ------------------------------
+
+def _dense_init(key, shape, dtype, fan_in):
+    return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(dtype)
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> dict:
+    """Parameter pytree. Layer params are stacked on a leading axis for
+    lax.scan (shape (n_layers, ...))."""
+    keys = jax.random.split(rng, 9)
+    d, h, kv, hd, ff, L = (
+        cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim,
+        cfg.d_ff, cfg.n_layers,
+    )
+
+    def stack_init(key, shape, fan_in):
+        ks = jax.random.split(key, L)
+        return jnp.stack([_dense_init(k, shape, cfg.dtype, fan_in) for k in ks])
+
+    return {
+        "embed": _dense_init(keys[0], (cfg.vocab_size, d), cfg.dtype, 1.0),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), cfg.dtype),
+            "wq": stack_init(keys[1], (d, h * hd), d),
+            "wk": stack_init(keys[2], (d, kv * hd), d),
+            "wv": stack_init(keys[3], (d, kv * hd), d),
+            "wo": stack_init(keys[4], (h * hd, d), h * hd),
+            "mlp_norm": jnp.ones((L, d), cfg.dtype),
+            "w_gate": stack_init(keys[5], (d, ff), d),
+            "w_up": stack_init(keys[6], (d, ff), d),
+            "w_down": stack_init(keys[7], (ff, d), ff),
+        },
+        "final_norm": jnp.ones((d,), cfg.dtype),
+        # LLaMA uses a separate (untied) output head
+        "lm_head": _dense_init(keys[8], (d, cfg.vocab_size), cfg.dtype, d),
+    }
+
+
+def logical_axes(cfg: ModelConfig) -> dict:
+    """Logical sharding axes, one tuple per param leaf (None = replicated
+    dim). Mapped to mesh axes by parallel/sharding.py's rules."""
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layer", "embed"),
+            "wq": ("layer", "embed", "heads"),
+            "wk": ("layer", "embed", "kv"),
+            "wv": ("layer", "embed", "kv"),
+            "wo": ("layer", "heads", "embed"),
+            "mlp_norm": ("layer", "embed"),
+            "w_gate": ("layer", "embed", "mlp"),
+            "w_up": ("layer", "embed", "mlp"),
+            "w_down": ("layer", "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def param_count(params: dict) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))
+
+
+# -- forward ----------------------------------------------------------------
+
+def _block(cfg: ModelConfig, cos, sin, x, layer):
+    """One transformer block. x: (batch, seq, d_model)."""
+    b, s, d = x.shape
+    h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    # attention
+    y = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (y @ layer["wq"]).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    k = (y @ layer["wk"]).reshape(b, s, kv, hd).transpose(0, 2, 1, 3)
+    v = (y @ layer["wv"]).reshape(b, s, kv, hd).transpose(0, 2, 1, 3)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if kv != h:  # GQA: repeat kv heads
+        rep = h // kv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    attn = flash_attention(
+        q, k, v, causal=True,
+        block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
+        use_pallas=cfg.use_pallas,
+    )
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
+    x = x + attn @ layer["wo"]
+
+    # SwiGLU MLP
+    y = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gated = jax.nn.silu(y @ layer["w_gate"]) * (y @ layer["w_up"])
+    return x + gated @ layer["w_down"]
+
+
+def forward(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """tokens (batch, seq) int32 → logits (batch, seq, vocab) float32."""
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    x = params["embed"][tokens]
+
+    block = lambda x, layer: (_block(cfg, cos, sin, x, layer), None)
+    if cfg.remat:
+        block = jax.checkpoint(block)  # trade FLOPs for HBM across layers
+    x, _ = jax.lax.scan(block, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Next-token cross-entropy over (batch, seq) tokens."""
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)

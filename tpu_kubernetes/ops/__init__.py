@@ -1,2 +1,12 @@
-"""tpu_kubernetes.ops — part of the in-tree TPU compute stack (being built;
-see __graft_entry__.py and bench.py once present)."""
+"""tpu_kubernetes.ops — TPU kernels and core numerical ops for the in-tree
+training stack (flash attention in Pallas; RMSNorm/RoPE as XLA-fused jnp)."""
+
+from tpu_kubernetes.ops.flash_attention import (  # noqa: F401
+    attention_reference,
+    flash_attention,
+)
+from tpu_kubernetes.ops.norms import (  # noqa: F401
+    apply_rope,
+    rms_norm,
+    rope_frequencies,
+)

@@ -1,0 +1,394 @@
+"""Causal flash attention as a Pallas TPU kernel (fwd + bwd, custom VJP).
+
+The hot op of the in-tree training stack (the framework's MaxText-analog
+example job, SURVEY §2.7). Design follows the TPU flash-attention pattern:
+
+* Online-softmax forward: grid over (batch, heads, q-blocks); K/V live in
+  VMEM per (b,h) and are walked block-by-block with a dynamic-bound
+  ``fori_loop`` so causal q-blocks stop at the diagonal. Log-sum-exp is saved
+  for the backward pass.
+* Backward as two kernels: dQ (grid over q-blocks, walking K/V) and dK/dV
+  (grid over kv-blocks, walking Q), both recomputing P from the saved LSE —
+  O(seq) memory instead of the O(seq²) score matrix.
+* All matmuls accumulate in float32 (``preferred_element_type``) and tiles
+  are 128-aligned for the MXU.
+
+A plain-XLA reference implementation is kept alongside: it is the
+correctness oracle in tests (pallas runs in interpret mode on CPU) and the
+fallback on non-TPU backends.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-capable installs; interpret mode needs pl only
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+# TPU block specs need the trailing dims tile-aligned; scalar-per-row
+# tensors (lse, delta) therefore carry a small broadcast lane dim.
+LSE_LANES = 8
+
+
+def _vmem_spec(block_shape=None, index_map=None):
+    kwargs = {}
+    if _VMEM is not None:
+        kwargs["memory_space"] = _VMEM
+    if block_shape is None:
+        return pl.BlockSpec(**kwargs)
+    return pl.BlockSpec(block_shape, index_map, **kwargs)
+
+
+# --------------------------------------------------------------------------
+# reference (XLA) implementation — oracle + non-TPU fallback
+# --------------------------------------------------------------------------
+
+def attention_reference(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Plain-XLA attention. q,k,v: (batch, heads, seq, head_dim)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * sm_scale
+    if causal:
+        seq_q, seq_k = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((seq_q, seq_k), dtype=bool), seq_k - seq_q)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+
+
+# --------------------------------------------------------------------------
+# forward kernel
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                block_q, block_k, causal, sm_scale, offset):
+    # offset = seq_k - seq_q aligns the causal diagonal bottom-right, matching
+    # attention_reference for cross-length (e.g. decode) calls
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale          # (block_q, d)
+    seq_k = k_ref.shape[2]
+    head_dim = q.shape[-1]
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+
+    row_ids = offset + qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                    # (block_q, block_k)
+        if causal:
+            col_ids = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(col_ids <= row_ids, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    if causal:
+        # q block qi only attends kv blocks up to its (offset-aligned) diagonal
+        num_kb = jnp.minimum(
+            ((qi + 1) * block_q + offset + block_k - 1) // block_k,
+            seq_k // block_k,
+        )
+    else:
+        num_kb = seq_k // block_k
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    lse = m + jnp.log(l)                                     # (block_q, 1)
+    lse_ref[0, 0] = jnp.broadcast_to(lse, (block_q, LSE_LANES)).astype(
+        lse_ref.dtype
+    )
+
+
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    batch, heads, seq_q, head_dim = q.shape
+    seq_k = k.shape[2]
+    grid = (batch, heads, seq_q // block_q)
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, block_q=block_q, block_k=block_k,
+            causal=causal, sm_scale=sm_scale, offset=seq_k - seq_q,
+        ),
+        grid=grid,
+        in_specs=[
+            _vmem_spec((1, 1, block_q, head_dim), lambda b, h, i: (b, h, i, 0)),
+            _vmem_spec((1, 1, seq_k, head_dim), lambda b, h, i: (b, h, 0, 0)),
+            _vmem_spec((1, 1, seq_k, head_dim), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, 1, block_q, head_dim), lambda b, h, i: (b, h, i, 0)),
+            _vmem_spec((1, 1, block_q, LSE_LANES), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, heads, seq_q, LSE_LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# --------------------------------------------------------------------------
+# backward kernels
+# --------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   block_q, block_k, causal, sm_scale, offset):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)                      # (block_q, d)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0][:, None]                       # (block_q, 1)
+    delta = delta_ref[0, 0, :, 0][:, None]
+    seq_k = k_ref.shape[2]
+    head_dim = q.shape[-1]
+
+    row_ids = offset + qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(kb, dq):
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = sm_scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            col_ids = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(col_ids <= row_ids, s, NEG_INF)
+        p = jnp.exp(s - lse)                                 # (block_q, block_k)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        num_kb = jnp.minimum(
+            ((qi + 1) * block_q + offset + block_k - 1) // block_k,
+            seq_k // block_k,
+        )
+    else:
+        num_kb = seq_k // block_k
+    dq = jax.lax.fori_loop(
+        0, num_kb, body, jnp.zeros((block_q, head_dim), jnp.float32)
+    )
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q, block_k, causal, sm_scale,
+                    offset):
+    ki = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)                      # (block_k, d)
+    v = v_ref[0, 0].astype(jnp.float32)
+    seq_q = q_ref.shape[2]
+    head_dim = k.shape[-1]
+
+    col_ids = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    def body(qb_rel, carry):
+        dk, dv, qb0 = carry
+        qb = qb0 + qb_rel
+        q = q_ref[0, 0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q), 0][:, None]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q), 0][:, None]
+        s = sm_scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            row_ids = offset + qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            s = jnp.where(col_ids <= row_ids, s, NEG_INF)
+        p = jnp.exp(s - lse)                                 # (block_q, block_k)
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk_new, dv_new, qb0
+
+    zeros = jnp.zeros((block_k, head_dim), jnp.float32)
+    if causal:
+        # kv block ki only receives gradient from q rows at/after its first
+        # column (offset-aligned)
+        qb0 = jnp.maximum(ki * block_k - offset, 0) // block_q
+        num_qb = seq_q // block_q - qb0
+    else:
+        qb0 = jnp.int32(0)
+        num_qb = seq_q // block_q
+    dk, dv, _ = jax.lax.fori_loop(0, num_qb, body, (zeros, zeros, qb0))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(causal, sm_scale, block_q, block_k, interpret, residuals, do):
+    q, k, v, o, lse = residuals
+    batch, heads, seq_q, head_dim = q.shape
+    seq_k = k.shape[2]
+    # delta = rowsum(dO * O) — cheap XLA op, fused upstream; broadcast to
+    # the lane-aligned layout the kernels read
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )                                                        # (b, h, seq_q, 1)
+    delta = jnp.broadcast_to(delta, (*delta.shape[:-1], LSE_LANES))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, block_q=block_q, block_k=block_k,
+            causal=causal, sm_scale=sm_scale, offset=seq_k - seq_q,
+        ),
+        grid=(batch, heads, seq_q // block_q),
+        in_specs=[
+            _vmem_spec((1, 1, block_q, head_dim), lambda b, h, i: (b, h, i, 0)),
+            _vmem_spec((1, 1, seq_k, head_dim), lambda b, h, i: (b, h, 0, 0)),
+            _vmem_spec((1, 1, seq_k, head_dim), lambda b, h, i: (b, h, 0, 0)),
+            _vmem_spec((1, 1, block_q, head_dim), lambda b, h, i: (b, h, i, 0)),
+            _vmem_spec((1, 1, block_q, LSE_LANES), lambda b, h, i: (b, h, i, 0)),
+            _vmem_spec((1, 1, block_q, LSE_LANES), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_specs=_vmem_spec(
+            (1, 1, block_q, head_dim), lambda b, h, i: (b, h, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+            causal=causal, sm_scale=sm_scale, offset=seq_k - seq_q,
+        ),
+        grid=(batch, heads, seq_k // block_k),
+        in_specs=[
+            _vmem_spec((1, 1, seq_q, head_dim), lambda b, h, i: (b, h, 0, 0)),
+            _vmem_spec((1, 1, block_k, head_dim), lambda b, h, i: (b, h, i, 0)),
+            _vmem_spec((1, 1, block_k, head_dim), lambda b, h, i: (b, h, i, 0)),
+            _vmem_spec((1, 1, seq_q, head_dim), lambda b, h, i: (b, h, 0, 0)),
+            _vmem_spec((1, 1, seq_q, LSE_LANES), lambda b, h, i: (b, h, 0, 0)),
+            _vmem_spec((1, 1, seq_q, LSE_LANES), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, 1, block_k, head_dim), lambda b, h, i: (b, h, i, 0)),
+            _vmem_spec((1, 1, block_k, head_dim), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# custom-VJP wrapper + public dispatcher
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, do):
+    return _bwd(causal, sm_scale, block_q, block_k, interpret, residuals, do)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    use_pallas: bool | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Causal attention over (batch, heads, seq, head_dim) tensors.
+
+    ``use_pallas=None`` auto-selects: the Pallas kernel on TPU, the XLA
+    reference elsewhere. ``interpret=True`` forces the kernel through the
+    Pallas interpreter (CPU-testable).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if use_pallas is None:
+        use_pallas = _on_tpu()
+    if not (use_pallas or interpret):
+        return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+
+    seq_q, seq_k = q.shape[2], k.shape[2]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    if seq_q % block_q or seq_k % block_k:
+        raise ValueError(
+            f"sequence lengths ({seq_q}, {seq_k}) must be divisible by the "
+            f"block sizes ({block_q}, {block_k})"
+        )
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)

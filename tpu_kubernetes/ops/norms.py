@@ -1,0 +1,54 @@
+"""RMSNorm and rotary position embeddings.
+
+Kept as plain jnp on purpose: these are bandwidth-bound elementwise ops that
+XLA fuses into their surrounding matmuls — a hand-written Pallas kernel would
+only re-derive the fusion XLA already performs (unlike attention, where the
+O(seq²) intermediate forces the flash restructuring in
+ops/flash_attention.py). Computation runs in float32 and casts back, the
+standard recipe for bf16 training stability.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """LLaMA-style RMSNorm: x * rsqrt(mean(x²)) * weight."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rope_frequencies(
+    head_dim: int, max_seq: int, theta: float = 10000.0
+) -> tuple[jax.Array, jax.Array]:
+    """Precompute cos/sin tables of shape (max_seq, head_dim // 2)."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(
+    x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array | None = None
+) -> jax.Array:
+    """Rotate pairs of channels. x: (batch, heads, seq, head_dim);
+    cos/sin: (max_seq, head_dim//2); positions: (seq,) or None for 0..seq-1."""
+    seq = x.shape[2]
+    if positions is None:
+        cos_t, sin_t = cos[:seq], sin[:seq]
+    else:
+        cos_t, sin_t = cos[positions], sin[positions]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos_t = cos_t[None, None, :, :]
+    sin_t = sin_t[None, None, :, :]
+    rotated = jnp.concatenate(
+        [x1 * cos_t - x2 * sin_t, x1 * sin_t + x2 * cos_t], axis=-1
+    )
+    return rotated.astype(x.dtype)

@@ -1,2 +1,21 @@
-"""tpu_kubernetes.parallel — part of the in-tree TPU compute stack (being built;
-see __graft_entry__.py and bench.py once present)."""
+"""tpu_kubernetes.parallel — mesh/sharding, multi-host bootstrap, and
+context parallelism for the in-tree training stack."""
+
+from tpu_kubernetes.parallel.distributed import (  # noqa: F401
+    DistributedEnv,
+    initialize,
+    read_env,
+)
+from tpu_kubernetes.parallel.mesh import (  # noqa: F401
+    DEFAULT_RULES,
+    MESH_AXES,
+    batch_sharding,
+    create_mesh,
+    logical_to_spec,
+    mesh_shape_for_devices,
+    param_shardings,
+)
+from tpu_kubernetes.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_attention_sharded,
+)

@@ -1,0 +1,59 @@
+"""Multi-host bootstrap: consume the env contract the provisioner emits.
+
+The gcp-tpu node module bakes /etc/tpu-kubernetes/jax.env into every slice
+host (terraform/modules/files/install_tpu_agent.sh.tpl):
+
+  JAX_COORDINATOR_ADDRESS  host:port of process 0
+  JAX_NUM_PROCESSES        hosts in the slice
+  JAX_PROCESS_ID           this host's index
+  TPU_ACCELERATOR_TYPE / TPU_SLICE_TOPOLOGY / TPU_SLICE_NAME
+
+This module is the consumer side (SURVEY §5.8): the training job calls
+:func:`initialize` first thing and the slice assembles over DCN while
+collectives inside the slice ride ICI. On single-host (or when the env is
+absent) it is a no-op, so the same entrypoint runs everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class DistributedEnv:
+    coordinator_address: str | None
+    num_processes: int
+    process_id: int
+    accelerator_type: str | None
+    slice_topology: str | None
+
+    @property
+    def multi_host(self) -> bool:
+        return self.num_processes > 1
+
+
+def read_env(env: dict[str, str] | None = None) -> DistributedEnv:
+    e = env if env is not None else os.environ
+    return DistributedEnv(
+        coordinator_address=e.get("JAX_COORDINATOR_ADDRESS"),
+        num_processes=int(e.get("JAX_NUM_PROCESSES", "1")),
+        process_id=int(e.get("JAX_PROCESS_ID", "0")),
+        accelerator_type=e.get("TPU_ACCELERATOR_TYPE"),
+        slice_topology=e.get("TPU_SLICE_TOPOLOGY"),
+    )
+
+
+def initialize(env: dict[str, str] | None = None) -> DistributedEnv:
+    """Call jax.distributed.initialize from the provisioner's env contract.
+    No-op on single-host. Safe to call exactly once, before device use."""
+    denv = read_env(env)
+    if denv.multi_host and denv.coordinator_address:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=denv.coordinator_address,
+            num_processes=denv.num_processes,
+            process_id=denv.process_id,
+        )
+    return denv

@@ -1,0 +1,109 @@
+"""Device mesh + logical-axis sharding rules.
+
+The scaling-book recipe: pick a mesh, annotate shardings with logical names,
+let XLA insert the collectives. Axes:
+
+  data     — pure data parallelism (gradient psum over DCN or ICI)
+  fsdp     — fully-sharded data parallel (params sharded, all-gathered
+             per-layer; rides ICI)
+  tensor   — megatron-style tensor parallelism (heads/mlp sharded; psum
+             per-block; innermost, fastest ICI axis)
+  sequence — context parallelism for long sequences (ring attention,
+             parallel/ring_attention.py)
+
+Logical param/activation axes (models/llama.py logical_axes) map to mesh
+axes through RULES; the same model code runs on any mesh shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+MESH_AXES = ("data", "fsdp", "tensor", "sequence")
+
+# logical axis → mesh axis (None = replicate). The fsdp axis shards the
+# embed dimension of every weight (ZeRO-3-style); tensor shards heads/mlp.
+DEFAULT_RULES: dict[str, str | None] = {
+    "batch": "data",          # activation batch over data axis
+    "fsdp_batch": "fsdp",     # batch also over fsdp when it's a data axis
+    "embed": "fsdp",
+    "heads": "tensor",
+    "kv": "tensor",
+    "mlp": "tensor",
+    "vocab": "tensor",
+    "layer": None,            # scan axis is never sharded
+    "seq": "sequence",
+}
+
+
+def create_mesh(
+    shape: Mapping[str, int], devices: Sequence[jax.Device] | None = None
+) -> Mesh:
+    """Build a Mesh from {axis: size}. Axis order follows MESH_AXES so the
+    innermost (fastest-varying, best-ICI-locality) axis is tensor/sequence."""
+    shape = {k: v for k, v in shape.items() if v != 0}
+    unknown = set(shape) - set(MESH_AXES)
+    if unknown:
+        raise ValueError(f"unknown mesh axes {sorted(unknown)}; known {MESH_AXES}")
+    axis_names = tuple(a for a in MESH_AXES if a in shape)
+    sizes = tuple(shape[a] for a in axis_names)
+    if devices is None:
+        devices = jax.devices()
+    total = int(np.prod(sizes)) if sizes else 1
+    if total != len(devices):
+        raise ValueError(
+            f"mesh {dict(shape)} wants {total} devices, have {len(devices)}"
+        )
+    device_array = mesh_utils.create_device_mesh(sizes, devices=list(devices))
+    return Mesh(device_array, axis_names)
+
+
+def logical_to_spec(
+    logical: Sequence[str | None],
+    rules: Mapping[str, str | None] = DEFAULT_RULES,
+    mesh: Mesh | None = None,
+) -> PartitionSpec:
+    """Map a tuple of logical axis names to a PartitionSpec, dropping mesh
+    axes that don't exist on (or are trivial in) the given mesh."""
+    entries = []
+    for name in logical:
+        mesh_axis = rules.get(name) if name is not None else None
+        if mesh_axis is not None and mesh is not None:
+            if mesh_axis not in mesh.axis_names or mesh.shape[mesh_axis] == 1:
+                mesh_axis = None
+        entries.append(mesh_axis)
+    return PartitionSpec(*entries)
+
+
+def param_shardings(
+    logical_tree: Any, mesh: Mesh, rules: Mapping[str, str | None] = DEFAULT_RULES
+) -> Any:
+    """Pytree of NamedShardings matching a logical_axes() tree."""
+    return jax.tree.map(
+        lambda logical: NamedSharding(mesh, logical_to_spec(logical, rules, mesh)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Input batch: sharded over every data-like axis present (data × fsdp)."""
+    data_axes = tuple(
+        a for a in ("data", "fsdp") if a in mesh.axis_names and mesh.shape[a] > 1
+    )
+    if not data_axes:
+        return NamedSharding(mesh, PartitionSpec())
+    return NamedSharding(mesh, PartitionSpec(data_axes))
+
+
+def mesh_shape_for_devices(n: int) -> dict[str, int]:
+    """A sensible default mesh for n devices: tensor innermost (2 if even),
+    rest fsdp, data=1 (fsdp already data-parallels the batch)."""
+    tensor = 2 if n % 2 == 0 and n >= 2 else 1
+    fsdp = n // tensor
+    return {"data": 1, "fsdp": fsdp, "tensor": tensor}

@@ -1,0 +1,120 @@
+"""Ring attention: exact context parallelism over a mesh ``sequence`` axis.
+
+Long-context training shards the sequence dimension across devices; each
+device holds one sequence block of Q, K, V. K/V blocks rotate around the
+ring with ``lax.ppermute`` (ICI neighbor exchange — bandwidth-optimal) while
+every device accumulates its Q block's attention with the online-softmax
+combine, so the full O(seq²) score matrix never materializes on any one
+device and communication overlaps compute ring-step by ring-step.
+
+Runs inside ``shard_map``; :func:`ring_attention_sharded` is the convenience
+wrapper. Causality is handled by the block order: the block originating at
+ring position j contributes fully when j < i (all its positions precede
+mine), causally when j == i, and not at all when j > i.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.experimental.shard_map import shard_map
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, mask, sm_scale):
+    """Scores + masked partial softmax stats for one (q-block, kv-block)
+    pair. q: (b,h,sq,d); k,v: (b,h,sk,d); mask broadcastable to (sq,sk)."""
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * sm_scale
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)                   # (b,h,sq,1)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return m, l, pv
+
+
+def ring_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
+    causal: bool = True, sm_scale: float | None = None,
+) -> jax.Array:
+    """Call INSIDE shard_map. q,k,v: the local sequence shard
+    (batch, heads, seq_local, head_dim)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    sq = q.shape[2]
+    qf = q.astype(jnp.float32)
+
+    local_tri = (
+        jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 1)
+        <= jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 0)
+    )
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(t, carry):
+        m, l, acc, kt, vt = carry
+        j = (my - t) % n  # ring position this K/V block originated at
+        if causal:
+            # j < my: full block; j == my: causal triangle; j > my: nothing
+            mask = jnp.where(
+                j < my,
+                jnp.ones((sq, sq), bool),
+                jnp.where(j == my, local_tri, jnp.zeros((sq, sq), bool)),
+            )
+        else:
+            mask = jnp.ones((sq, sq), bool)
+        bm, bl, bpv = _block_attn(qf, kt, vt, mask, sm_scale)
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(bm - m_new)
+        l_new = l * alpha + bl * beta
+        acc_new = acc * alpha + bpv * beta
+        # rotate K/V to the next ring position (ICI neighbor exchange)
+        kt = jax.lax.ppermute(kt, axis_name, perm)
+        vt = jax.lax.ppermute(vt, axis_name, perm)
+        return m_new, l_new, acc_new, kt, vt
+
+    b, h, _, d = q.shape
+    m0 = jnp.full((b, h, sq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    # the carry becomes device-varying inside the loop; mark the initial
+    # values as varying over the ring axis so the loop types are stable
+    m0, l0, acc0 = jax.lax.pcast((m0, l0, acc0), (axis_name,), to='varying')
+    m, l, acc, _, _ = jax.lax.fori_loop(
+        0, n, step, (m0, l0, acc0, k.astype(jnp.float32), v.astype(jnp.float32))
+    )
+    # guard fully-masked rows (can't happen with causal j==my triangle, but
+    # keeps the non-square/edge cases NaN-free)
+    l = jnp.maximum(l, 1e-30)
+    return (acc / l).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+    seq_axis: str = "sequence", causal: bool = True,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """shard_map wrapper: shards the sequence dim of (b, h, seq, d) inputs
+    over ``seq_axis`` and runs the ring."""
+    spec = PartitionSpec(None, None, seq_axis, None)
+    fn = shard_map(
+        functools.partial(
+            ring_attention, axis_name=seq_axis, causal=causal, sm_scale=sm_scale
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

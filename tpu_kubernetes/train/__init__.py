@@ -1,2 +1,12 @@
-"""tpu_kubernetes.train — part of the in-tree TPU compute stack (being built;
-see __graft_entry__.py and bench.py once present)."""
+"""tpu_kubernetes.train — training loop, optimizer, and checkpointing for
+the in-tree example job."""
+
+from tpu_kubernetes.train.trainer import (  # noqa: F401
+    TrainConfig,
+    init_state,
+    make_optimizer,
+    make_sharded_train_step,
+    state_shardings,
+    synthetic_batches,
+    train_step,
+)

@@ -1,0 +1,68 @@
+"""Checkpoint/resume for the training job (orbax-backed).
+
+For *infrastructure*, the state-doc + tfstate pair is the checkpoint
+(SURVEY §5.4); model/optimizer checkpointing belongs to the training job and
+lives here: async-capable orbax save/restore of the whole train state, with
+step-numbered directories and latest-step discovery — the GCS-destination
+analog of MaxText's checkpointing.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import jax
+
+
+class CheckpointError(Exception):
+    pass
+
+
+def _import_ocp():
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError as e:  # pragma: no cover
+        raise CheckpointError("orbax-checkpoint is not installed") from e
+    return ocp
+
+
+def _manager(directory: str | Path, max_to_keep: int = 3):
+    ocp = _import_ocp()
+    return ocp.CheckpointManager(
+        Path(directory).absolute(),
+        options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+    )
+
+
+def save(directory: str | Path, state: dict[str, Any], step: int,
+         max_to_keep: int = 3, wait: bool = True) -> None:
+    ocp = _import_ocp()
+    mgr = _manager(directory, max_to_keep)
+    mgr.save(step, args=ocp.args.StandardSave(state))
+    if wait:
+        mgr.wait_until_finished()
+    mgr.close()
+
+
+def latest_step(directory: str | Path) -> int | None:
+    mgr = _manager(directory)
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
+def restore(directory: str | Path, like: dict[str, Any],
+            step: int | None = None) -> dict[str, Any]:
+    """Restore into the structure/shardings of ``like`` (an abstract or
+    concrete train state)."""
+    ocp = _import_ocp()
+    mgr = _manager(directory)
+    if step is None:
+        step = mgr.latest_step()
+    if step is None:
+        raise CheckpointError(f"no checkpoints under {directory}")
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
+    restored = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    mgr.close()
+    return restored

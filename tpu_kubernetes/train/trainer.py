@@ -1,0 +1,123 @@
+"""Training step + state for the in-tree example job (MaxText analog).
+
+TPU-first: one jitted train step with donated state, sharded via logical
+axis rules over an arbitrary mesh (parallel/mesh.py); gradients reduce with
+whatever collectives XLA inserts for the mesh (psum over data/fsdp riding
+ICI). AdamW with global-norm clipping; loss in float32.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tpu_kubernetes.models import ModelConfig, init_params, logical_axes, loss_fn
+from tpu_kubernetes.parallel import batch_sharding, param_shardings
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.1
+    beta1: float = 0.9
+    beta2: float = 0.95
+    grad_clip: float = 1.0
+    warmup_steps: int = 100
+
+
+def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    schedule = optax.linear_schedule(0.0, tc.learning_rate, tc.warmup_steps)
+    return optax.chain(
+        optax.clip_by_global_norm(tc.grad_clip),
+        optax.adamw(
+            schedule, b1=tc.beta1, b2=tc.beta2, weight_decay=tc.weight_decay
+        ),
+    )
+
+
+def init_state(
+    rng: jax.Array, cfg: ModelConfig, tc: TrainConfig
+) -> dict[str, Any]:
+    params = init_params(rng, cfg)
+    opt_state = make_optimizer(tc).init(params)
+    return {"params": params, "opt_state": opt_state, "step": jnp.zeros((), jnp.int32)}
+
+
+def train_step(
+    state: dict[str, Any], batch: jax.Array, cfg: ModelConfig, tc: TrainConfig
+) -> tuple[dict[str, Any], jax.Array]:
+    """One optimizer step. batch: (per-global-batch, seq+1) int32 tokens."""
+    loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch, cfg)
+    updates, new_opt = make_optimizer(tc).update(
+        grads, state["opt_state"], state["params"]
+    )
+    new_params = optax.apply_updates(state["params"], updates)
+    return (
+        {"params": new_params, "opt_state": new_opt, "step": state["step"] + 1},
+        loss,
+    )
+
+
+def state_shardings(state: dict[str, Any], cfg: ModelConfig, mesh: Mesh) -> Any:
+    """Shardings for the whole train state: params by logical axes; optimizer
+    moments follow their parameters; scalars replicated."""
+    p_shardings = param_shardings(logical_axes(cfg), mesh)
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    # match opt_state structure by mapping over it with params-shaped
+    # subtrees replaced by p_shardings
+    def map_opt(tree):
+        params_treedef = jax.tree.structure(state["params"])
+        def rec(node):
+            if jax.tree.structure(node) == params_treedef:
+                return p_shardings
+            if hasattr(node, "_fields"):  # NamedTuple (optax states) — must
+                return type(node)(*(rec(x) for x in node))  # precede tuple
+            if isinstance(node, tuple):
+                return tuple(rec(x) for x in node)
+            if isinstance(node, list):
+                return [rec(x) for x in node]
+            if isinstance(node, dict):
+                return {k: rec(v) for k, v in node.items()}
+            return replicated
+        return rec(tree)
+
+    return {
+        "params": p_shardings,
+        "opt_state": map_opt(state["opt_state"]),
+        "step": replicated,
+    }
+
+
+def make_sharded_train_step(
+    cfg: ModelConfig, tc: TrainConfig, mesh: Mesh, state: dict[str, Any]
+) -> tuple[Callable, Any, NamedSharding]:
+    """→ (jitted step, state shardings, batch sharding). The returned step
+    donates the state buffer (in-place update on device)."""
+    shardings = state_shardings(state, cfg, mesh)
+    b_sharding = batch_sharding(mesh)
+    step = jax.jit(
+        functools.partial(train_step, cfg=cfg, tc=tc),
+        in_shardings=(shardings, b_sharding),
+        out_shardings=(shardings, NamedSharding(mesh, PartitionSpec())),
+        donate_argnums=(0,),
+    )
+    return step, shardings, b_sharding
+
+
+def synthetic_batches(
+    vocab_size: int, batch: int, seq: int, seed: int = 0
+) -> Iterator[jax.Array]:
+    """Deterministic synthetic token stream; batches are (batch, seq+1) so
+    the next-token loss sees exactly ``seq`` positions (keeps attention
+    sequence lengths block-aligned for the pallas kernel)."""
+    rng = jax.random.PRNGKey(seed)
+    while True:
+        rng, sub = jax.random.split(rng)
+        yield jax.random.randint(sub, (batch, seq + 1), 0, vocab_size, jnp.int32)
