@@ -1,0 +1,26 @@
+# Reference analog: Makefile (cross-compile + fpm + sha256; `make test` =
+# go test ./...). Python equivalents below.
+
+VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
+
+.PHONY: test test-fast bench dryrun dist clean
+
+test:
+	python -m pytest tests/ -q
+
+test-fast:
+	python -m pytest tests/ -q -m "not slow"
+
+bench:
+	python bench.py
+
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  python __graft_entry__.py 8
+
+dist: clean
+	python -m build
+	cd dist && sha256sum * > SHA256SUMS
+
+clean:
+	rm -rf build dist *.egg-info

@@ -85,8 +85,10 @@ def main() -> None:
         cfg = replace(cfg, max_seq=seq)
 
     devices = jax.devices()
-    n_chips = len(devices)
-    log(f"backend={jax.default_backend()} devices={n_chips} "
+    # the workload is pinned to devices[0] (jax.default_device below), so
+    # per-chip numbers normalize by 1 regardless of how many chips the host has
+    n_chips = 1
+    log(f"backend={jax.default_backend()} host_devices={len(devices)} "
         f"kind={getattr(devices[0], 'device_kind', '?')}")
     log(f"model={model_name} batch={batch} seq={seq}")
 

@@ -1,0 +1,41 @@
+#!/bin/sh
+# Bake the TPU agent image (run by packer inside the build VM).
+#
+# Everything installed here is something install_tpu_agent.sh.tpl would
+# otherwise fetch at boot — each item baked shaves seconds-to-minutes off
+# create→first-train-step (reference analog: the pre-pull list in
+# packer/rancher-agent.yaml:10-36).
+set -eu
+
+export DEBIAN_FRONTEND=noninteractive
+
+# 1. JAX for TPU (the base image carries libtpu; pin jax to match)
+pip install --no-cache-dir -U "jax[tpu]" flax optax orbax-checkpoint einops \
+  -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+# 2. the framework's training stack
+pip install --no-cache-dir tpu-kubernetes[tpu]
+
+# 3. k3s binary + airgap images (no curl|sh at boot; the boot script detects
+#    the preinstalled binary and skips the download)
+curl -sfL -o /usr/local/bin/k3s \
+  "https://github.com/k3s-io/k3s/releases/latest/download/k3s"
+chmod +x /usr/local/bin/k3s
+mkdir -p /var/lib/rancher/k3s/agent/images
+curl -sfL -o /var/lib/rancher/k3s/agent/images/k3s-airgap-images-amd64.tar.zst \
+  "https://github.com/k3s-io/k3s/releases/latest/download/k3s-airgap-images-amd64.tar.zst"
+
+# 4. warm the XLA compile cache for the flagship shapes so the first real
+#    train step skips most of compilation
+export JAX_COMPILATION_CACHE_DIR=/var/cache/tpu-kubernetes/xla
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+python - <<'EOF' || echo "cache warm skipped (no TPU attached at bake time)"
+import jax
+if jax.default_backend() != "tpu":
+    raise SystemExit(1)
+import __graft_entry__ as graft
+fn, args = graft.entry()
+jax.jit(fn)(*args)
+EOF
+
+echo "bake complete"

@@ -1,0 +1,51 @@
+# TPU-VM image pipeline: pre-bake everything the boot path would otherwise
+# download, because create→first-train-step latency is the product metric.
+#
+# Reference analog: packer/rancher-agent.yaml — the reference pre-pulls ~25
+# rancher/k8s images into its agent image (packer/rancher-agent.yaml:10-36);
+# in the GPU north-star framing that image carries nvidia-docker+CUDA+NCCL.
+# The TPU replacement bakes: libtpu+JAX (already on the TPU-VM base image),
+# the tpu-kubernetes python stack, the k3s binary + airgap images, and a
+# warmed XLA compile cache for the flagship model shapes.
+
+packer {
+  required_plugins {
+    googlecompute = {
+      version = ">= 1.1"
+      source  = "github.com/hashicorp/googlecompute"
+    }
+  }
+}
+
+variable "project_id" {
+  type = string
+}
+
+variable "zone" {
+  type    = string
+  default = "us-east5-a"
+}
+
+variable "source_image_family" {
+  type    = string
+  default = "tpu-ubuntu2204-base" # TPU-VM base: libtpu + drivers preinstalled
+}
+
+source "googlecompute" "tpu_vm" {
+  project_id          = var.project_id
+  zone                = var.zone
+  source_image_family = var.source_image_family
+  image_name          = "tpu-kubernetes-agent-{{timestamp}}"
+  image_family        = "tpu-kubernetes-agent"
+  machine_type        = "n2-standard-8"
+  disk_size           = 100
+  ssh_username        = "packer"
+}
+
+build {
+  sources = ["source.googlecompute.tpu_vm"]
+
+  provisioner "shell" {
+    script = "${path.root}/scripts/bake_tpu_agent.sh"
+  }
+}

@@ -1,0 +1,27 @@
+# Cluster output contract + provider handles (SURVEY §2.3; reference:
+# aws-rancher-k8s outputs).
+
+output "cluster_id" {
+  value = data.external.register_cluster.result.cluster_id
+}
+
+output "registration_token" {
+  value     = data.external.register_cluster.result.registration_token
+  sensitive = true
+}
+
+output "ca_checksum" {
+  value = data.external.register_cluster.result.ca_checksum
+}
+
+output "aws_subnet_id" {
+  value = aws_subnet.cluster.id
+}
+
+output "aws_security_group_id" {
+  value = aws_security_group.cluster.id
+}
+
+output "aws_key_name" {
+  value = aws_key_pair.cluster.key_name
+}

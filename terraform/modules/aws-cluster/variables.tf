@@ -1,0 +1,52 @@
+variable "name" {}
+
+variable "api_url" {}
+
+variable "access_key" {}
+
+variable "secret_key" {
+  sensitive = true
+}
+
+variable "k8s_version" {
+  default = "v1.31.1"
+}
+
+variable "k8s_network_provider" {
+  default = "calico"
+}
+
+variable "aws_access_key" {}
+
+variable "aws_secret_key" {
+  sensitive = true
+}
+
+variable "aws_region" {
+  default = "us-east-1"
+}
+
+variable "aws_vpc_cidr" {
+  default = "10.0.0.0/16"
+}
+
+variable "aws_subnet_cidr" {
+  default = "10.0.2.0/24"
+}
+
+variable "aws_public_key_path" {
+  default = "~/.ssh/id_rsa.pub"
+}
+
+variable "private_registry" {
+  default = ""
+}
+
+variable "private_registry_username" {
+  default = ""
+}
+
+variable "private_registry_password" {
+  default   = ""
+  sensitive = true
+}

@@ -1,0 +1,96 @@
+# Cluster-manager VM on EC2 with its own VPC envelope.
+# Reference analog: aws-rancher/main.tf:7-107 (vpc/igw/subnet/route/key/sg +
+# aws_instance.host), :133-207 (install/setup).
+
+provider "aws" {
+  access_key = var.aws_access_key
+  secret_key = var.aws_secret_key
+  region     = var.aws_region
+}
+
+resource "aws_vpc" "manager" {
+  cidr_block           = var.aws_vpc_cidr
+  enable_dns_hostnames = true
+}
+
+resource "aws_internet_gateway" "manager" {
+  vpc_id = aws_vpc.manager.id
+}
+
+resource "aws_subnet" "manager" {
+  vpc_id                  = aws_vpc.manager.id
+  cidr_block              = var.aws_subnet_cidr
+  map_public_ip_on_launch = true
+}
+
+resource "aws_route_table" "manager" {
+  vpc_id = aws_vpc.manager.id
+
+  route {
+    cidr_block = "0.0.0.0/0"
+    gateway_id = aws_internet_gateway.manager.id
+  }
+}
+
+resource "aws_route_table_association" "manager" {
+  subnet_id      = aws_subnet.manager.id
+  route_table_id = aws_route_table.manager.id
+}
+
+resource "aws_security_group" "manager" {
+  vpc_id = aws_vpc.manager.id
+
+  ingress {
+    from_port   = 22
+    to_port     = 22
+    protocol    = "tcp"
+    cidr_blocks = ["0.0.0.0/0"]
+  }
+
+  ingress {
+    from_port   = 6443
+    to_port     = 6443
+    protocol    = "tcp"
+    cidr_blocks = ["0.0.0.0/0"]
+  }
+
+  egress {
+    from_port   = 0
+    to_port     = 0
+    protocol    = "-1"
+    cidr_blocks = ["0.0.0.0/0"]
+  }
+}
+
+resource "aws_key_pair" "manager" {
+  key_name   = "${var.name}-manager"
+  public_key = file(pathexpand(var.aws_public_key_path))
+}
+
+resource "aws_instance" "manager" {
+  ami                    = var.aws_ami_id
+  instance_type          = var.aws_instance_type
+  subnet_id              = aws_subnet.manager.id
+  vpc_security_group_ids = [aws_security_group.manager.id]
+  key_name               = aws_key_pair.manager.key_name
+
+  user_data = templatefile("${path.module}/../files/install_manager.sh.tpl", {
+    admin_password = var.admin_password
+    manager_name   = var.name
+  })
+
+  tags = {
+    Name = "${var.name}-manager"
+  }
+}
+
+data "external" "api_key" {
+  depends_on = [aws_instance.manager]
+  program = ["sh", "-c", <<-EOT
+    ssh -o StrictHostKeyChecking=no ${aws_instance.manager.public_ip} \
+      'printf "{\"access_key\": \"%s\", \"secret_key\": \"%s\"}" \
+        "$(cat ~/.tpu-kubernetes/api_access_key)" \
+        "$(cat ~/.tpu-kubernetes/api_secret_key)"'
+  EOT
+  ]
+}

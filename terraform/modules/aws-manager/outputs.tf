@@ -1,0 +1,14 @@
+# Manager output contract (SURVEY §2.3).
+
+output "api_url" {
+  value = "https://${aws_instance.manager.public_ip}:6443"
+}
+
+output "access_key" {
+  value = data.external.api_key.result.access_key
+}
+
+output "secret_key" {
+  value     = data.external.api_key.result.secret_key
+  sensitive = true
+}

@@ -1,0 +1,54 @@
+variable "name" {}
+
+variable "admin_password" {
+  sensitive = true
+}
+
+variable "server_image" {
+  default = ""
+}
+
+variable "agent_image" {
+  default = ""
+}
+
+variable "aws_access_key" {}
+
+variable "aws_secret_key" {
+  sensitive = true
+}
+
+variable "aws_region" {
+  default = "us-east-1"
+}
+
+variable "aws_vpc_cidr" {
+  default = "10.0.0.0/16"
+}
+
+variable "aws_subnet_cidr" {
+  default = "10.0.2.0/24"
+}
+
+variable "aws_ami_id" {}
+
+variable "aws_instance_type" {
+  default = "t3.xlarge"
+}
+
+variable "aws_public_key_path" {
+  default = "~/.ssh/id_rsa.pub"
+}
+
+variable "private_registry" {
+  default = ""
+}
+
+variable "private_registry_username" {
+  default = ""
+}
+
+variable "private_registry_password" {
+  default   = ""
+  sensitive = true
+}

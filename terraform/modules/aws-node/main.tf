@@ -1,0 +1,44 @@
+# One EC2 node. Reference analog: aws-rancher-k8s-host/main.tf:35-47
+# (aws_instance.host with user_data bootstrap), :49-70 (optional EBS
+# volume + attachment).
+
+provider "aws" {
+  access_key = var.aws_access_key
+  secret_key = var.aws_secret_key
+  region     = var.aws_region
+}
+
+resource "aws_instance" "node" {
+  ami                    = var.aws_ami_id
+  instance_type          = var.aws_instance_type
+  subnet_id              = var.aws_subnet_id
+  vpc_security_group_ids = [var.aws_security_group_id]
+  key_name               = var.aws_key_name
+
+  user_data = templatefile("${path.module}/../files/install_node_agent.sh.tpl", {
+    api_url            = var.api_url
+    registration_token = var.registration_token
+    ca_checksum        = var.ca_checksum
+    node_role          = var.node_role
+    hostname           = var.hostname
+    extra_labels       = ""
+  })
+
+  tags = {
+    Name = var.hostname
+  }
+}
+
+resource "aws_ebs_volume" "node" {
+  count             = var.aws_ebs_volume_size_gb > 0 ? 1 : 0
+  availability_zone = aws_instance.node.availability_zone
+  size              = var.aws_ebs_volume_size_gb
+  type              = var.aws_ebs_volume_type
+}
+
+resource "aws_volume_attachment" "node" {
+  count       = var.aws_ebs_volume_size_gb > 0 ? 1 : 0
+  device_name = "/dev/sdf"
+  volume_id   = aws_ebs_volume.node[0].id
+  instance_id = aws_instance.node.id
+}

@@ -1,0 +1,68 @@
+variable "hostname" {}
+
+variable "api_url" {}
+
+variable "access_key" {}
+
+variable "secret_key" {
+  sensitive = true
+}
+
+variable "registration_token" {
+  sensitive = true
+}
+
+variable "ca_checksum" {}
+
+variable "node_role" {
+  default = "worker"
+}
+
+variable "aws_access_key" {}
+
+variable "aws_secret_key" {
+  sensitive = true
+}
+
+variable "aws_region" {
+  default = "us-east-1"
+}
+
+variable "aws_ami_id" {}
+
+variable "aws_instance_type" {
+  default = "t3.xlarge"
+}
+
+variable "aws_ebs_volume_size_gb" {
+  default = 0
+}
+
+variable "aws_ebs_volume_type" {
+  default = "gp3"
+}
+
+variable "aws_subnet_id" {
+  description = "From the cluster module outputs (SURVEY §2.3)"
+}
+
+variable "aws_security_group_id" {
+  description = "From the cluster module outputs (SURVEY §2.3)"
+}
+
+variable "aws_key_name" {
+  description = "From the cluster module outputs (SURVEY §2.3)"
+}
+
+variable "private_registry" {
+  default = ""
+}
+
+variable "private_registry_username" {
+  default = ""
+}
+
+variable "private_registry_password" {
+  default   = ""
+  sensitive = true
+}

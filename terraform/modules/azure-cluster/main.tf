@@ -1,0 +1,72 @@
+# Cluster registration + RG/vnet/NSG envelope.
+# Reference analog: azure-rancher-k8s/main.tf:1-60.
+
+provider "azurerm" {
+  features {}
+  subscription_id = var.azure_subscription_id
+  client_id       = var.azure_client_id
+  client_secret   = var.azure_client_secret
+  tenant_id       = var.azure_tenant_id
+}
+
+data "external" "register_cluster" {
+  program = ["sh", "${path.module}/../files/register_cluster.sh"]
+  query = {
+    api_url          = var.api_url
+    access_key       = var.access_key
+    secret_key       = var.secret_key
+    name             = var.name
+    k8s_version      = var.k8s_version
+    network_provider = var.k8s_network_provider
+  }
+}
+
+resource "azurerm_resource_group" "cluster" {
+  name     = var.name
+  location = var.azure_location
+}
+
+resource "azurerm_virtual_network" "cluster" {
+  name                = "${var.name}-vnet"
+  address_space       = ["10.0.0.0/16"]
+  location            = azurerm_resource_group.cluster.location
+  resource_group_name = azurerm_resource_group.cluster.name
+}
+
+resource "azurerm_subnet" "cluster" {
+  name                 = "${var.name}-subnet"
+  resource_group_name  = azurerm_resource_group.cluster.name
+  virtual_network_name = azurerm_virtual_network.cluster.name
+  address_prefixes     = ["10.0.2.0/24"]
+}
+
+# k8s port matrix (reference analog: rke_ports)
+resource "azurerm_network_security_group" "cluster" {
+  name                = "${var.name}-nsg"
+  location            = azurerm_resource_group.cluster.location
+  resource_group_name = azurerm_resource_group.cluster.name
+
+  security_rule {
+    name                       = "k8s-ports"
+    priority                   = 100
+    direction                  = "Inbound"
+    access                     = "Allow"
+    protocol                   = "Tcp"
+    source_port_range          = "*"
+    destination_port_ranges    = ["22", "6443", "2379-2380", "10250", "30000-32767"]
+    source_address_prefix      = "*"
+    destination_address_prefix = "*"
+  }
+
+  security_rule {
+    name                       = "vxlan"
+    priority                   = 110
+    direction                  = "Inbound"
+    access                     = "Allow"
+    protocol                   = "Udp"
+    source_port_range          = "*"
+    destination_port_range     = "8472"
+    source_address_prefix      = "VirtualNetwork"
+    destination_address_prefix = "*"
+  }
+}

@@ -1,0 +1,26 @@
+# Cluster output contract + provider handles (SURVEY §2.3).
+
+output "cluster_id" {
+  value = data.external.register_cluster.result.cluster_id
+}
+
+output "registration_token" {
+  value     = data.external.register_cluster.result.registration_token
+  sensitive = true
+}
+
+output "ca_checksum" {
+  value = data.external.register_cluster.result.ca_checksum
+}
+
+output "azure_resource_group_name" {
+  value = azurerm_resource_group.cluster.name
+}
+
+output "azure_subnet_id" {
+  value = azurerm_subnet.cluster.id
+}
+
+output "azure_network_security_group_id" {
+  value = azurerm_network_security_group.cluster.id
+}

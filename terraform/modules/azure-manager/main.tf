@@ -1,0 +1,117 @@
+# Cluster-manager VM on Azure: RG + vnet + subnet + NSG + IP + NIC + VM.
+# Reference analog: azure-rancher/main.tf:9-115 (azurerm_* chain),
+# :131-209 (install/setup).
+
+provider "azurerm" {
+  features {}
+  subscription_id = var.azure_subscription_id
+  client_id       = var.azure_client_id
+  client_secret   = var.azure_client_secret
+  tenant_id       = var.azure_tenant_id
+}
+
+resource "azurerm_resource_group" "manager" {
+  name     = "${var.name}-manager"
+  location = var.azure_location
+}
+
+resource "azurerm_virtual_network" "manager" {
+  name                = "${var.name}-vnet"
+  address_space       = ["10.0.0.0/16"]
+  location            = azurerm_resource_group.manager.location
+  resource_group_name = azurerm_resource_group.manager.name
+}
+
+resource "azurerm_subnet" "manager" {
+  name                 = "${var.name}-subnet"
+  resource_group_name  = azurerm_resource_group.manager.name
+  virtual_network_name = azurerm_virtual_network.manager.name
+  address_prefixes     = ["10.0.2.0/24"]
+}
+
+resource "azurerm_network_security_group" "manager" {
+  name                = "${var.name}-nsg"
+  location            = azurerm_resource_group.manager.location
+  resource_group_name = azurerm_resource_group.manager.name
+
+  security_rule {
+    name                       = "ssh-and-api"
+    priority                   = 100
+    direction                  = "Inbound"
+    access                     = "Allow"
+    protocol                   = "Tcp"
+    source_port_range          = "*"
+    destination_port_ranges    = ["22", "6443"]
+    source_address_prefix      = "*"
+    destination_address_prefix = "*"
+  }
+}
+
+resource "azurerm_public_ip" "manager" {
+  name                = "${var.name}-ip"
+  location            = azurerm_resource_group.manager.location
+  resource_group_name = azurerm_resource_group.manager.name
+  allocation_method   = "Static"
+}
+
+resource "azurerm_network_interface" "manager" {
+  name                = "${var.name}-nic"
+  location            = azurerm_resource_group.manager.location
+  resource_group_name = azurerm_resource_group.manager.name
+
+  ip_configuration {
+    name                          = "primary"
+    subnet_id                     = azurerm_subnet.manager.id
+    private_ip_address_allocation = "Dynamic"
+    public_ip_address_id          = azurerm_public_ip.manager.id
+  }
+}
+
+resource "azurerm_network_interface_security_group_association" "manager" {
+  network_interface_id      = azurerm_network_interface.manager.id
+  network_security_group_id = azurerm_network_security_group.manager.id
+}
+
+resource "azurerm_linux_virtual_machine" "manager" {
+  name                  = "${var.name}-manager"
+  location              = azurerm_resource_group.manager.location
+  resource_group_name   = azurerm_resource_group.manager.name
+  network_interface_ids = [azurerm_network_interface.manager.id]
+  size                  = var.azure_size
+  admin_username        = var.azure_ssh_user
+
+  admin_ssh_key {
+    username   = var.azure_ssh_user
+    public_key = file(pathexpand(var.azure_public_key_path))
+  }
+
+  os_disk {
+    caching              = "ReadWrite"
+    storage_account_type = "Premium_LRS"
+  }
+
+  source_image_reference {
+    publisher = var.azure_image_publisher
+    offer     = var.azure_image_offer
+    sku       = var.azure_image_sku
+    version   = "latest"
+  }
+
+  custom_data = base64encode(templatefile(
+    "${path.module}/../files/install_manager.sh.tpl", {
+      admin_password = var.admin_password
+      manager_name   = var.name
+    }
+  ))
+}
+
+data "external" "api_key" {
+  depends_on = [azurerm_linux_virtual_machine.manager]
+  program = ["sh", "-c", <<-EOT
+    ssh -o StrictHostKeyChecking=no ${var.azure_ssh_user}@${azurerm_public_ip.manager.ip_address} \
+      'printf "{\"access_key\": \"%s\", \"secret_key\": \"%s\"}" \
+        "$(cat ~/.tpu-kubernetes/api_access_key)" \
+        "$(cat ~/.tpu-kubernetes/api_secret_key)"'
+  EOT
+  ]
+}

@@ -1,0 +1,14 @@
+# Manager output contract (SURVEY §2.3).
+
+output "api_url" {
+  value = "https://${azurerm_public_ip.manager.ip_address}:6443"
+}
+
+output "access_key" {
+  value = data.external.api_key.result.access_key
+}
+
+output "secret_key" {
+  value     = data.external.api_key.result.secret_key
+  sensitive = true
+}

@@ -1,0 +1,64 @@
+variable "name" {}
+
+variable "admin_password" {
+  sensitive = true
+}
+
+variable "server_image" {
+  default = ""
+}
+
+variable "agent_image" {
+  default = ""
+}
+
+variable "azure_subscription_id" {}
+
+variable "azure_client_id" {}
+
+variable "azure_client_secret" {
+  sensitive = true
+}
+
+variable "azure_tenant_id" {}
+
+variable "azure_location" {
+  default = "eastus"
+}
+
+variable "azure_size" {
+  default = "Standard_D4s_v5"
+}
+
+variable "azure_image_publisher" {
+  default = "Canonical"
+}
+
+variable "azure_image_offer" {
+  default = "0001-com-ubuntu-server-jammy"
+}
+
+variable "azure_image_sku" {
+  default = "22_04-lts-gen2"
+}
+
+variable "azure_ssh_user" {
+  default = "ubuntu"
+}
+
+variable "azure_public_key_path" {
+  default = "~/.ssh/id_rsa.pub"
+}
+
+variable "private_registry" {
+  default = ""
+}
+
+variable "private_registry_username" {
+  default = ""
+}
+
+variable "private_registry_password" {
+  default   = ""
+  sensitive = true
+}

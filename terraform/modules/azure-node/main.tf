@@ -1,0 +1,72 @@
+# One Azure VM node. Reference analog: azure-rancher-k8s-host/main.tf:34-110
+# (ip/nic/managed-disk/vm).
+
+provider "azurerm" {
+  features {}
+  subscription_id = var.azure_subscription_id
+  client_id       = var.azure_client_id
+  client_secret   = var.azure_client_secret
+  tenant_id       = var.azure_tenant_id
+}
+
+resource "azurerm_public_ip" "node" {
+  name                = "${var.hostname}-ip"
+  location            = var.azure_location
+  resource_group_name = var.azure_resource_group_name
+  allocation_method   = "Static"
+}
+
+resource "azurerm_network_interface" "node" {
+  name                = "${var.hostname}-nic"
+  location            = var.azure_location
+  resource_group_name = var.azure_resource_group_name
+
+  ip_configuration {
+    name                          = "primary"
+    subnet_id                     = var.azure_subnet_id
+    private_ip_address_allocation = "Dynamic"
+    public_ip_address_id          = azurerm_public_ip.node.id
+  }
+}
+
+resource "azurerm_network_interface_security_group_association" "node" {
+  network_interface_id      = azurerm_network_interface.node.id
+  network_security_group_id = var.azure_network_security_group_id
+}
+
+resource "azurerm_linux_virtual_machine" "node" {
+  name                  = var.hostname
+  location              = var.azure_location
+  resource_group_name   = var.azure_resource_group_name
+  network_interface_ids = [azurerm_network_interface.node.id]
+  size                  = var.azure_size
+  admin_username        = var.azure_ssh_user
+
+  admin_ssh_key {
+    username   = var.azure_ssh_user
+    public_key = file(pathexpand(var.azure_public_key_path))
+  }
+
+  os_disk {
+    caching              = "ReadWrite"
+    storage_account_type = "Premium_LRS"
+  }
+
+  source_image_reference {
+    publisher = var.azure_image_publisher
+    offer     = var.azure_image_offer
+    sku       = var.azure_image_sku
+    version   = "latest"
+  }
+
+  custom_data = base64encode(templatefile(
+    "${path.module}/../files/install_node_agent.sh.tpl", {
+      api_url            = var.api_url
+      registration_token = var.registration_token
+      ca_checksum        = var.ca_checksum
+      node_role          = var.node_role
+      hostname           = var.hostname
+      extra_labels       = ""
+    }
+  ))
+}

@@ -1,0 +1,15 @@
+# Cluster registration object only (Triton clusters ride existing fabric
+# networks). Reference analog: triton-rancher-k8s/main.tf:1
+# (data.external rancher_cluster).
+
+data "external" "register_cluster" {
+  program = ["sh", "${path.module}/../files/register_cluster.sh"]
+  query = {
+    api_url          = var.api_url
+    access_key       = var.access_key
+    secret_key       = var.secret_key
+    name             = var.name
+    k8s_version      = var.k8s_version
+    network_provider = var.k8s_network_provider
+  }
+}

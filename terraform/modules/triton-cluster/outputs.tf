@@ -1,0 +1,16 @@
+# The cluster output contract consumed by node modules as
+# ${module.cluster_<provider>_<name>.*} (SURVEY §2.3; reference:
+# gcp-rancher-k8s/outputs.tf:1-19).
+
+output "cluster_id" {
+  value = data.external.register_cluster.result.cluster_id
+}
+
+output "registration_token" {
+  value     = data.external.register_cluster.result.registration_token
+  sensitive = true
+}
+
+output "ca_checksum" {
+  value = data.external.register_cluster.result.ca_checksum
+}

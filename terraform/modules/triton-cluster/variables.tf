@@ -1,0 +1,42 @@
+variable "name" {}
+
+variable "api_url" {}
+
+variable "access_key" {}
+
+variable "secret_key" {
+  sensitive = true
+}
+
+variable "k8s_version" {
+  default = "v1.31.1"
+}
+
+variable "k8s_network_provider" {
+  default = "calico"
+}
+
+variable "triton_account" {}
+
+variable "triton_key_id" {}
+
+variable "triton_key_path" {
+  default = "~/.ssh/id_rsa"
+}
+
+variable "triton_url" {
+  default = "https://us-east-1.api.joyent.com"
+}
+
+variable "private_registry" {
+  default = ""
+}
+
+variable "private_registry_username" {
+  default = ""
+}
+
+variable "private_registry_password" {
+  default   = ""
+  sensitive = true
+}

@@ -1,0 +1,59 @@
+# Cluster-manager machine on Triton.
+# Reference analog: triton-rancher/main.tf:21-39 (triton_machine with CNS +
+# anti-affinity), :73-144 (shared install/setup null_resources).
+
+provider "triton" {
+  account  = var.triton_account
+  key_id   = var.triton_key_id
+  url      = var.triton_url
+}
+
+data "triton_image" "manager" {
+  name        = var.triton_image_name
+  most_recent = true
+}
+
+data "triton_network" "manager" {
+  count = length(var.triton_network_names)
+  name  = var.triton_network_names[count.index]
+}
+
+resource "triton_machine" "manager" {
+  name    = "${var.name}-manager"
+  package = var.triton_machine_package
+  image   = data.triton_image.manager.id
+
+  networks = data.triton_network.manager[*].id
+
+  cns {
+    services = ["${var.name}-manager"]
+  }
+}
+
+resource "null_resource" "install_manager" {
+  connection {
+    type        = "ssh"
+    host        = triton_machine.manager.primaryip
+    user        = "ubuntu"
+    private_key = file(pathexpand(var.triton_key_path))
+  }
+
+  provisioner "remote-exec" {
+    inline = [templatefile("${path.module}/../files/install_manager.sh.tpl", {
+      admin_password = var.admin_password
+      manager_name   = var.name
+    })]
+  }
+}
+
+data "external" "api_key" {
+  depends_on = [null_resource.install_manager]
+  program = ["sh", "-c", <<-EOT
+    ssh -o StrictHostKeyChecking=no -i ${pathexpand(var.triton_key_path)} \
+      ubuntu@${triton_machine.manager.primaryip} \
+      'printf "{\"access_key\": \"%s\", \"secret_key\": \"%s\"}" \
+        "$(cat ~/.tpu-kubernetes/api_access_key)" \
+        "$(cat ~/.tpu-kubernetes/api_secret_key)"'
+  EOT
+  ]
+}

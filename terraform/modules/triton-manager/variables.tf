@@ -1,0 +1,53 @@
+variable "name" {}
+
+variable "admin_password" {
+  sensitive = true
+}
+
+variable "server_image" {
+  default = ""
+}
+
+variable "agent_image" {
+  default = ""
+}
+
+variable "triton_account" {}
+
+variable "triton_key_id" {
+  description = "MD5 fingerprint of the SSH key (derived by util/ssh.py)"
+}
+
+variable "triton_key_path" {
+  default = "~/.ssh/id_rsa"
+}
+
+variable "triton_url" {
+  default = "https://us-east-1.api.joyent.com"
+}
+
+variable "triton_network_names" {
+  type    = list(string)
+  default = ["Joyent-SDC-Public"]
+}
+
+variable "triton_image_name" {
+  default = "ubuntu-certified-22.04"
+}
+
+variable "triton_machine_package" {
+  default = "g4-highcpu-4G"
+}
+
+variable "private_registry" {
+  default = ""
+}
+
+variable "private_registry_username" {
+  default = ""
+}
+
+variable "private_registry_password" {
+  default   = ""
+  sensitive = true
+}

@@ -1,0 +1,41 @@
+# One Triton machine node. Reference analog:
+# triton-rancher-k8s-host/main.tf:44-60 (triton_machine.host with
+# user_script agent bootstrap and per-role CNS tag).
+
+provider "triton" {
+  account = var.triton_account
+  key_id  = var.triton_key_id
+  url     = var.triton_url
+}
+
+data "triton_image" "node" {
+  name        = var.triton_image_name
+  most_recent = true
+}
+
+data "triton_network" "node" {
+  count = length(var.triton_network_names)
+  name  = var.triton_network_names[count.index]
+}
+
+resource "triton_machine" "node" {
+  name    = var.hostname
+  package = var.triton_machine_package
+  image   = data.triton_image.node.id
+
+  networks = data.triton_network.node[*].id
+
+  user_script = templatefile("${path.module}/../files/install_node_agent.sh.tpl", {
+    api_url            = var.api_url
+    registration_token = var.registration_token
+    ca_checksum        = var.ca_checksum
+    node_role          = var.node_role
+    hostname           = var.hostname
+    extra_labels       = ""
+  })
+
+  # per-role CNS service tag (reference: triton-rancher-k8s-host/main.tf:44-60)
+  cns {
+    services = ["${var.node_role}-node"]
+  }
+}

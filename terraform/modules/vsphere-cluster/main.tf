@@ -1,0 +1,40 @@
+# Cluster registration + datacenter data lookups.
+# Reference analog: vsphere-rancher-k8s/main.tf:1-42.
+
+provider "vsphere" {
+  vsphere_server       = var.vsphere_server
+  user                 = var.vsphere_user
+  password             = var.vsphere_password
+  allow_unverified_ssl = true
+}
+
+data "external" "register_cluster" {
+  program = ["sh", "${path.module}/../files/register_cluster.sh"]
+  query = {
+    api_url          = var.api_url
+    access_key       = var.access_key
+    secret_key       = var.secret_key
+    name             = var.name
+    k8s_version      = var.k8s_version
+    network_provider = var.k8s_network_provider
+  }
+}
+
+data "vsphere_datacenter" "cluster" {
+  name = var.vsphere_datacenter_name
+}
+
+data "vsphere_datastore" "cluster" {
+  name          = var.vsphere_datastore_name
+  datacenter_id = data.vsphere_datacenter.cluster.id
+}
+
+data "vsphere_resource_pool" "cluster" {
+  name          = var.vsphere_resource_pool_name
+  datacenter_id = data.vsphere_datacenter.cluster.id
+}
+
+data "vsphere_network" "cluster" {
+  name          = var.vsphere_network_name
+  datacenter_id = data.vsphere_datacenter.cluster.id
+}

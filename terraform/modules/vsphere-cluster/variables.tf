@@ -1,0 +1,46 @@
+variable "name" {}
+
+variable "api_url" {}
+
+variable "access_key" {}
+
+variable "secret_key" {
+  sensitive = true
+}
+
+variable "k8s_version" {
+  default = "v1.31.1"
+}
+
+variable "k8s_network_provider" {
+  default = "calico"
+}
+
+variable "vsphere_server" {}
+
+variable "vsphere_user" {}
+
+variable "vsphere_password" {
+  sensitive = true
+}
+
+variable "vsphere_datacenter_name" {}
+
+variable "vsphere_datastore_name" {}
+
+variable "vsphere_resource_pool_name" {}
+
+variable "vsphere_network_name" {}
+
+variable "private_registry" {
+  default = ""
+}
+
+variable "private_registry_username" {
+  default = ""
+}
+
+variable "private_registry_password" {
+  default   = ""
+  sensitive = true
+}

@@ -1,0 +1,75 @@
+# One VM node cloned from a template. Reference analog:
+# vsphere-rancher-k8s-host/main.tf:56-100 (vsphere_virtual_machine clone +
+# remote-exec).
+
+provider "vsphere" {
+  vsphere_server       = var.vsphere_server
+  user                 = var.vsphere_user
+  password             = var.vsphere_password
+  allow_unverified_ssl = true
+}
+
+data "vsphere_datacenter" "node" {
+  name = var.vsphere_datacenter_name
+}
+
+data "vsphere_datastore" "node" {
+  name          = var.vsphere_datastore_name
+  datacenter_id = data.vsphere_datacenter.node.id
+}
+
+data "vsphere_resource_pool" "node" {
+  name          = var.vsphere_resource_pool_name
+  datacenter_id = data.vsphere_datacenter.node.id
+}
+
+data "vsphere_network" "node" {
+  name          = var.vsphere_network_name
+  datacenter_id = data.vsphere_datacenter.node.id
+}
+
+data "vsphere_virtual_machine" "template" {
+  name          = var.vsphere_template_name
+  datacenter_id = data.vsphere_datacenter.node.id
+}
+
+resource "vsphere_virtual_machine" "node" {
+  name             = var.hostname
+  resource_pool_id = data.vsphere_resource_pool.node.id
+  datastore_id     = data.vsphere_datastore.node.id
+
+  num_cpus = data.vsphere_virtual_machine.template.num_cpus
+  memory   = data.vsphere_virtual_machine.template.memory
+  guest_id = data.vsphere_virtual_machine.template.guest_id
+
+  network_interface {
+    network_id = data.vsphere_network.node.id
+  }
+
+  disk {
+    label = "disk0"
+    size  = data.vsphere_virtual_machine.template.disks[0].size
+  }
+
+  clone {
+    template_uuid = data.vsphere_virtual_machine.template.id
+  }
+
+  connection {
+    type        = "ssh"
+    host        = self.default_ip_address
+    user        = var.ssh_user
+    private_key = file(pathexpand(var.key_path))
+  }
+
+  provisioner "remote-exec" {
+    inline = [templatefile("${path.module}/../files/install_node_agent.sh.tpl", {
+      api_url            = var.api_url
+      registration_token = var.registration_token
+      ca_checksum        = var.ca_checksum
+      node_role          = var.node_role
+      hostname           = var.hostname
+      extra_labels       = ""
+    })]
+  }
+}

@@ -1,0 +1,58 @@
+variable "hostname" {}
+
+variable "api_url" {}
+
+variable "access_key" {}
+
+variable "secret_key" {
+  sensitive = true
+}
+
+variable "registration_token" {
+  sensitive = true
+}
+
+variable "ca_checksum" {}
+
+variable "node_role" {
+  default = "worker"
+}
+
+variable "vsphere_server" {}
+
+variable "vsphere_user" {}
+
+variable "vsphere_password" {
+  sensitive = true
+}
+
+variable "vsphere_datacenter_name" {}
+
+variable "vsphere_datastore_name" {}
+
+variable "vsphere_resource_pool_name" {}
+
+variable "vsphere_network_name" {}
+
+variable "vsphere_template_name" {}
+
+variable "ssh_user" {
+  default = "ubuntu"
+}
+
+variable "key_path" {
+  default = "~/.ssh/id_rsa"
+}
+
+variable "private_registry" {
+  default = ""
+}
+
+variable "private_registry_username" {
+  default = ""
+}
+
+variable "private_registry_password" {
+  default   = ""
+  sensitive = true
+}

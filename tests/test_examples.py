@@ -1,0 +1,64 @@
+"""The shipped silent-install examples must actually work end-to-end
+through the CLI (dry-run executor)."""
+
+import json
+
+import pytest
+
+from tpu_kubernetes.cli import main
+
+EXAMPLES = "examples/silent-install"
+
+
+@pytest.fixture()
+def cli_home(tk_home, monkeypatch, tmp_path):
+    monkeypatch.setenv("TPU_K8S_TERRAFORM_BIN", "definitely-not-terraform-xyz")
+    creds = tmp_path / "creds.json"
+    creds.write_text(json.dumps({"project_id": "example-proj"}))
+    return tk_home, creds
+
+
+def test_manager_and_ha_cluster_examples(cli_home):
+    tk_home, creds = cli_home
+    assert main([
+        "--config", f"{EXAMPLES}/create-manager.yaml", "--non-interactive",
+        "--set", f"gcp_path_to_credentials={creds}",
+        "create", "manager",
+    ]) == 0
+    assert main([
+        "--config", f"{EXAMPLES}/cluster-baremetal-ha.yaml", "--non-interactive",
+        "create", "cluster",
+    ]) == 0
+    doc = json.loads((tk_home / "global-manager" / "main.tf.json").read_text())
+    nodes = [k for k in doc["module"] if k.startswith("node_baremetal_ha-cluster_")]
+    assert len(nodes) == 10  # 3 etcd + 3 control + 4 workers
+    roles = {doc["module"][k]["node_role"] for k in nodes}
+    assert roles == {"etcd", "control", "worker"}
+
+
+def test_tpu_cluster_examples(cli_home):
+    tk_home, creds = cli_home
+    assert main([
+        "--config", f"{EXAMPLES}/create-manager.yaml", "--non-interactive",
+        "--set", f"gcp_path_to_credentials={creds}",
+        "create", "manager",
+    ]) == 0
+    for example, cluster_key, n_slices in [
+        ("cluster-gcp-tpu-v5e4.yaml", "cluster_gcp-tpu_tpu-dev", 1),
+        ("cluster-gcp-tpu-v5p32.yaml", "cluster_gcp-tpu_tpu-train", 2),
+    ]:
+        assert main([
+            "--config", f"{EXAMPLES}/{example}", "--non-interactive",
+            "--set", f"gcp_path_to_credentials={creds}",
+            "create", "cluster",
+        ]) == 0
+        doc = json.loads((tk_home / "global-manager" / "main.tf.json").read_text())
+        assert cluster_key in doc["module"]
+        slices = [k for k in doc["module"] if k.startswith("node_gcp-tpu_")
+                  and cluster_key.split("_", 2)[2] in k]
+        assert len(slices) >= n_slices
+    # v5e-4 single-host slice emits the API name
+    dev_nodes = [k for k in doc["module"] if "tpu-dev" in k and k.startswith("node")]
+    node = doc["module"][dev_nodes[0]]
+    assert node["tpu_accelerator_type"] == "v5litepod-4"
+    assert node["tpu_hosts"] == 1

@@ -1,0 +1,142 @@
+"""Cross-provider contract test: for every registered provider, build a full
+manager+cluster+node state with canned config and run the render-time
+validator against the in-repo terraform modules. Any drift between a
+provider's emitted keys and its module's variables/outputs fails here
+(SURVEY §7 hard part #5, mechanically enforced for the whole matrix)."""
+
+import pytest
+
+from tpu_kubernetes.config import Config
+from tpu_kubernetes.create.node import add_nodes
+from tpu_kubernetes.providers import (
+    BuildContext,
+    cluster_providers,
+    get_provider,
+    manager_providers,
+)
+from tpu_kubernetes.shell import validate_document
+from tpu_kubernetes.shell.outputs import inject_root_outputs
+from tpu_kubernetes.state import State
+
+COMMON = {
+    "name": "c1",
+    "manager_admin_password": "pw",
+    "k8s_version": "v1.31.1",
+    "k8s_network_provider": "calico",
+    "node_count": 1,
+    "hostname_prefix": "n",
+}
+
+PROVIDER_VALUES = {
+    "baremetal": {
+        "host": "10.0.0.10",
+        "hosts": "10.0.0.21",
+        "ssh_user": "ubuntu",
+        "key_path": "~/.ssh/id_rsa",
+    },
+    "gcp": {
+        "gcp_path_to_credentials": "/nonexistent.json",
+        "gcp_project_id": "proj",
+        "gcp_compute_region": "us-central1",
+        "gcp_zone": "us-central1-a",
+        "gcp_machine_type": "n2-standard-4",
+        "gcp_image": "ubuntu-os-cloud/ubuntu-2204-lts",
+    },
+    "gcp-tpu": {
+        "gcp_path_to_credentials": "/nonexistent.json",
+        "gcp_project_id": "proj",
+        "gcp_compute_region": "us-east5",
+        "gcp_zone": "us-east5-a",
+        "tpu_accelerator_type": "v5p-32",
+    },
+    "aws": {
+        "aws_access_key": "AKIA",
+        "aws_secret_key": "shh",
+        "aws_region": "us-east-1",
+        "aws_ami_id": "ami-123",
+        "aws_instance_type": "t3.xlarge",
+        "aws_public_key_path": "~/.ssh/id_rsa.pub",
+    },
+    "azure": {
+        "azure_subscription_id": "sub",
+        "azure_client_id": "client",
+        "azure_client_secret": "shh",
+        "azure_tenant_id": "tenant",
+        "azure_location": "eastus",
+        "azure_size": "Standard_D4s_v5",
+        "azure_public_key_path": "~/.ssh/id_rsa.pub",
+    },
+    "triton": {
+        "triton_account": "acct",
+        "triton_key_id": "aa:bb:cc",
+        "triton_key_path": "~/.ssh/id_rsa",
+        "triton_machine_package": "g4-highcpu-4G",
+    },
+    "vsphere": {
+        "vsphere_server": "vc.local",
+        "vsphere_user": "admin",
+        "vsphere_password": "shh",
+        "vsphere_datacenter_name": "dc",
+        "vsphere_datastore_name": "ds",
+        "vsphere_resource_pool_name": "pool",
+        "vsphere_network_name": "net",
+        "vsphere_template_name": "tmpl",
+        "ssh_user": "ubuntu",
+        "key_path": "~/.ssh/id_rsa",
+    },
+}
+
+
+def make_cfg(provider):
+    return Config({**COMMON, **PROVIDER_VALUES[provider]},
+                  non_interactive=True, env={})
+
+
+def test_all_expected_providers_registered():
+    assert sorted(cluster_providers()) == [
+        "aws", "azure", "baremetal", "gcp", "gcp-tpu", "triton", "vsphere",
+    ]
+    assert sorted(manager_providers()) == [
+        "aws", "azure", "baremetal", "gcp", "triton",
+    ]  # vsphere (ref: manager.go:119 commented out) and gcp-tpu have none
+
+
+@pytest.mark.parametrize("provider_name", sorted(cluster_providers()))
+def test_full_stack_config_matches_modules(provider_name):
+    provider = get_provider(provider_name)
+    state = State("dev")
+
+    # manager: use the provider's own when supported, else baremetal
+    mgr_provider = provider if provider.build_manager else get_provider("baremetal")
+    mgr_name = provider_name if provider.build_manager else "baremetal"
+    mgr_cfg = make_cfg(mgr_name)
+    ctx = BuildContext(cfg=mgr_cfg, state=state, name="dev")
+    state.set_manager(mgr_provider.build_manager(ctx, {}))
+
+    cfg = make_cfg(provider_name)
+    ctx = BuildContext(cfg=cfg, state=state, name="c1")
+    cluster_key = state.add_cluster(provider_name, "c1", provider.build_cluster(ctx, {}))
+
+    hostnames = add_nodes(state, cfg, cluster_key)
+    assert hostnames
+
+    validate_document(state)       # variables + interpolation contracts
+    inject_root_outputs(state)     # output forwarding resolves
+    assert state.get("output")
+
+
+def test_triton_key_id_derived_from_private_key(tmp_path):
+    """Without an explicit triton_key_id, the md5 fingerprint is derived
+    from the key file (reference: util/ssh_utils.go:13-42)."""
+    pytest.importorskip("cryptography")
+    from tests.test_ssh import write_key
+
+    key_path, expected = write_key(tmp_path)
+    values = {**COMMON, **PROVIDER_VALUES["triton"]}
+    del values["triton_key_id"]
+    values["triton_key_path"] = str(key_path)
+    cfg = Config(values, non_interactive=True, env={})
+    state = State("dev")
+    ctx = BuildContext(cfg=cfg, state=state, name="dev")
+    out = get_provider("triton").build_manager(ctx, {})
+    assert out["triton_key_id"] == expected

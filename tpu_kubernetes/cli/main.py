@@ -25,7 +25,7 @@ from tpu_kubernetes.state import StateError
 from tpu_kubernetes.topology import TopologyError
 from tpu_kubernetes.util.backend_prompt import prompt_for_backend
 from tpu_kubernetes.util.prompts import PromptError
-from tpu_kubernetes.utils.trace import TRACER
+from tpu_kubernetes.util.trace import TRACER
 
 
 def build_parser() -> argparse.ArgumentParser:

@@ -22,7 +22,7 @@ from tpu_kubernetes.providers.base import ProviderError, prompt_name
 from tpu_kubernetes.shell import Executor, validate_document
 from tpu_kubernetes.shell.outputs import inject_root_outputs
 from tpu_kubernetes.state import State
-from tpu_kubernetes.utils.trace import TRACER
+from tpu_kubernetes.util.trace import TRACER
 
 # node-group keys that scope per-group in the YAML nodes: fan-out
 # (reference: create/cluster.go:165-217 — viper.Set per group)

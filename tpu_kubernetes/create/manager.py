@@ -19,7 +19,7 @@ from tpu_kubernetes.providers.base import ProviderError, prompt_name
 from tpu_kubernetes.shell import Executor, validate_document
 from tpu_kubernetes.shell.outputs import inject_root_outputs
 from tpu_kubernetes.state import State
-from tpu_kubernetes.utils.trace import TRACER
+from tpu_kubernetes.util.trace import TRACER
 
 
 def new_manager(backend: Backend, cfg: Config, executor: Executor) -> State:

@@ -22,7 +22,7 @@ from tpu_kubernetes.shell import Executor, validate_document
 from tpu_kubernetes.shell.outputs import inject_root_outputs
 from tpu_kubernetes.state import State, cluster_key_parts
 from tpu_kubernetes.util import new_hostnames, validate_name
-from tpu_kubernetes.utils.trace import TRACER
+from tpu_kubernetes.util.trace import TRACER
 
 
 def select_manager(backend: Backend, cfg: Config) -> str:

@@ -16,7 +16,7 @@ from tpu_kubernetes.create.node import select_cluster, select_manager
 from tpu_kubernetes.providers.base import ProviderError
 from tpu_kubernetes.shell import Executor
 from tpu_kubernetes.shell.outputs import inject_root_outputs
-from tpu_kubernetes.utils.trace import TRACER
+from tpu_kubernetes.util.trace import TRACER
 
 
 def _is_dry_run(executor: Executor) -> bool:

@@ -11,6 +11,10 @@ from tpu_kubernetes.providers.base import (  # noqa: F401
 )
 
 # importing a provider module registers it
+from tpu_kubernetes.providers import aws  # noqa: F401,E402
+from tpu_kubernetes.providers import azure  # noqa: F401,E402
 from tpu_kubernetes.providers import baremetal  # noqa: F401,E402
 from tpu_kubernetes.providers import gcp  # noqa: F401,E402
 from tpu_kubernetes.providers import gcp_tpu  # noqa: F401,E402
+from tpu_kubernetes.providers import triton  # noqa: F401,E402
+from tpu_kubernetes.providers import vsphere  # noqa: F401,E402

@@ -1,0 +1,106 @@
+"""AWS (EC2) provider.
+
+reference: create/manager_aws.go:29-47 (manager config),
+create/cluster_aws.go:29-41 (VPC/subnet CIDR, key pair),
+create/node_aws.go:28-58 (instance type, EBS volume options).
+
+The reference validates AMIs/instance types via aws-sdk-go mid-prompt
+(create/node_aws.go:87-120); validation here is left to terraform plan so
+the flow stays hermetic (same decision as the gcp provider).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpu_kubernetes.providers.base import (
+    BuildContext,
+    Provider,
+    base_cluster_config,
+    base_manager_config,
+    base_node_config,
+    register,
+)
+
+DEFAULT_REGION = "us-east-1"
+DEFAULT_INSTANCE_TYPE = "t3.xlarge"
+DEFAULT_AMI = "ami-0c7217cdde317cfec"  # ubuntu 22.04 us-east-1
+DEFAULT_VPC_CIDR = "10.0.0.0/16"
+DEFAULT_SUBNET_CIDR = "10.0.2.0/24"
+
+
+def _aws_common(ctx: BuildContext, out: dict[str, Any]) -> None:
+    cfg = ctx.cfg
+    out["aws_access_key"] = cfg.get("aws_access_key", prompt="AWS access key")
+    out["aws_secret_key"] = cfg.get(
+        "aws_secret_key", prompt="AWS secret key", secret=True
+    )
+    out["aws_region"] = cfg.get("aws_region", prompt="AWS region",
+                                default=DEFAULT_REGION)
+
+
+def build_manager(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """reference: create/manager_aws.go:29-47."""
+    out = base_manager_config(ctx, "aws")
+    _aws_common(ctx, out)
+    cfg = ctx.cfg
+    out["aws_vpc_cidr"] = cfg.get("aws_vpc_cidr", default=DEFAULT_VPC_CIDR)
+    out["aws_subnet_cidr"] = cfg.get("aws_subnet_cidr", default=DEFAULT_SUBNET_CIDR)
+    out["aws_ami_id"] = cfg.get("aws_ami_id", prompt="AMI id", default=DEFAULT_AMI)
+    out["aws_instance_type"] = cfg.get(
+        "aws_instance_type", prompt="instance type", default=DEFAULT_INSTANCE_TYPE
+    )
+    out["aws_public_key_path"] = cfg.get(
+        "aws_public_key_path", prompt="SSH public key path",
+        default="~/.ssh/id_rsa.pub",
+    )
+    return out
+
+
+def build_cluster(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """reference: create/cluster_aws.go:29-41 — the cluster owns its VPC,
+    subnet, security group, and key pair."""
+    out = base_cluster_config(ctx, "aws")
+    _aws_common(ctx, out)
+    cfg = ctx.cfg
+    out["aws_vpc_cidr"] = cfg.get("aws_vpc_cidr", default=DEFAULT_VPC_CIDR)
+    out["aws_subnet_cidr"] = cfg.get("aws_subnet_cidr", default=DEFAULT_SUBNET_CIDR)
+    out["aws_public_key_path"] = cfg.get(
+        "aws_public_key_path", prompt="SSH public key path",
+        default="~/.ssh/id_rsa.pub",
+    )
+    return out
+
+
+def build_node(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """reference: create/node_aws.go:28-58; subnet/sg/key interpolated from
+    the cluster module outputs (same §2.3 contract as gcp)."""
+    out = base_node_config(ctx, "aws")
+    _aws_common(ctx, out)
+    cfg = ctx.cfg
+    out["aws_ami_id"] = cfg.get("aws_ami_id", prompt="AMI id", default=DEFAULT_AMI)
+    out["aws_instance_type"] = cfg.get(
+        "aws_instance_type", prompt="instance type", default=DEFAULT_INSTANCE_TYPE
+    )
+    # optional EBS volume (reference: create/node_aws.go:28-38,52-58)
+    ebs_gb = int(cfg.get("aws_ebs_volume_size_gb", default=0) or 0)
+    if ebs_gb:
+        out["aws_ebs_volume_size_gb"] = ebs_gb
+        out["aws_ebs_volume_type"] = cfg.get("aws_ebs_volume_type", default="gp3")
+    out["aws_subnet_id"] = f"${{module.{ctx.cluster_key}.aws_subnet_id}}"
+    out["aws_security_group_id"] = (
+        f"${{module.{ctx.cluster_key}.aws_security_group_id}}"
+    )
+    out["aws_key_name"] = f"${{module.{ctx.cluster_key}.aws_key_name}}"
+    return out
+
+
+register(
+    Provider(
+        name="aws",
+        display="Amazon Web Services (EC2)",
+        build_manager=build_manager,
+        build_cluster=build_cluster,
+        build_node=build_node,
+    )
+)

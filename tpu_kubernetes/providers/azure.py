@@ -1,0 +1,99 @@
+"""Azure (ARM) provider.
+
+reference: create/manager_azure.go:27-47 (subscription/client/tenant creds,
+location, image), create/cluster_azure.go:25-36, create/node_azure.go:27-52
+(size, image publisher/offer/SKU).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpu_kubernetes.providers.base import (
+    BuildContext,
+    Provider,
+    base_cluster_config,
+    base_manager_config,
+    base_node_config,
+    register,
+)
+
+DEFAULT_LOCATION = "eastus"
+DEFAULT_SIZE = "Standard_D4s_v5"
+DEFAULT_IMAGE_PUBLISHER = "Canonical"
+DEFAULT_IMAGE_OFFER = "0001-com-ubuntu-server-jammy"
+DEFAULT_IMAGE_SKU = "22_04-lts-gen2"
+
+
+def _azure_common(ctx: BuildContext, out: dict[str, Any]) -> None:
+    cfg = ctx.cfg
+    out["azure_subscription_id"] = cfg.get(
+        "azure_subscription_id", prompt="Azure subscription id"
+    )
+    out["azure_client_id"] = cfg.get("azure_client_id", prompt="Azure client id")
+    out["azure_client_secret"] = cfg.get(
+        "azure_client_secret", prompt="Azure client secret", secret=True
+    )
+    out["azure_tenant_id"] = cfg.get("azure_tenant_id", prompt="Azure tenant id")
+    out["azure_location"] = cfg.get(
+        "azure_location", prompt="Azure location", default=DEFAULT_LOCATION
+    )
+
+
+def _azure_image(ctx: BuildContext, out: dict[str, Any]) -> None:
+    cfg = ctx.cfg
+    out["azure_image_publisher"] = cfg.get(
+        "azure_image_publisher", default=DEFAULT_IMAGE_PUBLISHER
+    )
+    out["azure_image_offer"] = cfg.get("azure_image_offer", default=DEFAULT_IMAGE_OFFER)
+    out["azure_image_sku"] = cfg.get("azure_image_sku", default=DEFAULT_IMAGE_SKU)
+    out["azure_size"] = cfg.get("azure_size", prompt="VM size", default=DEFAULT_SIZE)
+    out["azure_ssh_user"] = cfg.get("azure_ssh_user", default="ubuntu")
+    out["azure_public_key_path"] = cfg.get(
+        "azure_public_key_path", prompt="SSH public key path",
+        default="~/.ssh/id_rsa.pub",
+    )
+
+
+def build_manager(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """reference: create/manager_azure.go:27-47."""
+    out = base_manager_config(ctx, "azure")
+    _azure_common(ctx, out)
+    _azure_image(ctx, out)
+    return out
+
+
+def build_cluster(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """reference: create/cluster_azure.go:25-36 — cluster owns resource
+    group, vnet, and NSG."""
+    out = base_cluster_config(ctx, "azure")
+    _azure_common(ctx, out)
+    return out
+
+
+def build_node(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """reference: create/node_azure.go:27-52; RG/subnet/NSG interpolated
+    from cluster outputs."""
+    out = base_node_config(ctx, "azure")
+    _azure_common(ctx, out)
+    _azure_image(ctx, out)
+    ck = ctx.cluster_key
+    out["azure_resource_group_name"] = (
+        f"${{module.{ck}.azure_resource_group_name}}"
+    )
+    out["azure_subnet_id"] = f"${{module.{ck}.azure_subnet_id}}"
+    out["azure_network_security_group_id"] = (
+        f"${{module.{ck}.azure_network_security_group_id}}"
+    )
+    return out
+
+
+register(
+    Provider(
+        name="azure",
+        display="Microsoft Azure",
+        build_manager=build_manager,
+        build_cluster=build_cluster,
+        build_node=build_node,
+    )
+)

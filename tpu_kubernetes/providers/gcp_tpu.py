@@ -30,7 +30,12 @@ from tpu_kubernetes.providers.base import (
     register,
 )
 from tpu_kubernetes.providers.gcp import _gcp_common
-from tpu_kubernetes.topology import TopologyError, parse_accelerator_type, validate_mesh
+from tpu_kubernetes.topology import (
+    TopologyError,
+    parse_accelerator_type,
+    parse_mesh_shape,
+    validate_mesh,
+)
 
 # sensible TPU-VM runtime (software) versions by generation; overridable
 DEFAULT_RUNTIME_VERSIONS = {
@@ -57,24 +62,6 @@ def build_cluster(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
     create/cluster_gcp.go:28-34, module gcp-rancher-k8s)."""
     out = base_cluster_config(ctx, "gcp-tpu")
     _gcp_common(ctx, out)
-    return out
-
-
-def parse_mesh_shape(spec: str) -> dict[str, int]:
-    """``"data=2,fsdp=8"`` → {"data": 2, "fsdp": 8}."""
-    out: dict[str, int] = {}
-    for part in spec.split(","):
-        part = part.strip()
-        if not part:
-            continue
-        if "=" not in part:
-            raise ProviderError(
-                f"invalid mesh_shape entry {part!r}: expected axis=size"
-            )
-        axis, _, size = part.partition("=")
-        if not size.isdigit():
-            raise ProviderError(f"mesh_shape axis {axis!r} size must be an integer")
-        out[axis.strip()] = int(size)
     return out
 
 

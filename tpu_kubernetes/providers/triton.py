@@ -1,0 +1,111 @@
+"""Triton (Joyent/MNX) provider — the reference's home cloud.
+
+reference: create/manager_triton.go:30-43 (account, key id/path, url,
+networks, image, package), create/cluster_triton.go:21-28,
+create/node_triton.go:26-40. The Triton API identifies SSH keys by their
+MD5 fingerprint, derived from the private key (reference:
+util/ssh_utils.go:13-42 → util/ssh.py here).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpu_kubernetes.providers.base import (
+    BuildContext,
+    Provider,
+    ProviderError,
+    base_cluster_config,
+    base_manager_config,
+    base_node_config,
+    register,
+)
+from tpu_kubernetes.util.ssh import (
+    SSHKeyError,
+    SSHKeyNeedsPassphrase,
+    public_key_md5_fingerprint,
+)
+
+DEFAULT_TRITON_URL = "https://us-east-1.api.joyent.com"
+DEFAULT_IMAGE = "ubuntu-certified-22.04"
+DEFAULT_PACKAGE = "g4-highcpu-4G"
+
+
+def _triton_common(ctx: BuildContext, out: dict[str, Any]) -> None:
+    cfg = ctx.cfg
+    out["triton_account"] = cfg.get("triton_account", prompt="Triton account name")
+    key_path = cfg.get(
+        "triton_key_path", prompt="Triton SSH private key path",
+        default="~/.ssh/id_rsa",
+    )
+    out["triton_key_path"] = key_path
+    # key id = md5 fingerprint of the key (reference: manager_triton.go +
+    # util/ssh_utils.go:13-42); explicit config wins, else derive
+    if cfg.is_set("triton_key_id"):
+        out["triton_key_id"] = cfg.get("triton_key_id")
+    else:
+        try:
+            out["triton_key_id"] = public_key_md5_fingerprint(str(key_path))
+        except SSHKeyNeedsPassphrase:
+            passphrase = cfg.get(
+                "triton_key_passphrase", prompt="SSH key passphrase", secret=True
+            )
+            try:
+                out["triton_key_id"] = public_key_md5_fingerprint(
+                    str(key_path), passphrase=str(passphrase)
+                )
+            except SSHKeyError as e:
+                raise ProviderError(str(e)) from e
+        except SSHKeyError as e:
+            raise ProviderError(
+                f"cannot derive triton_key_id from {key_path}: {e} "
+                "(set triton_key_id explicitly)"
+            ) from e
+    out["triton_url"] = cfg.get("triton_url", default=DEFAULT_TRITON_URL)
+
+
+def _triton_instance(ctx: BuildContext, out: dict[str, Any]) -> None:
+    """Networks/image/package for any Triton machine (manager or node)."""
+    cfg = ctx.cfg
+    networks = cfg.get("triton_network_names", default="Joyent-SDC-Public")
+    if isinstance(networks, str):
+        networks = [n.strip() for n in networks.split(",") if n.strip()]
+    out["triton_network_names"] = networks
+    out["triton_image_name"] = cfg.get("triton_image_name", default=DEFAULT_IMAGE)
+    out["triton_machine_package"] = cfg.get(
+        "triton_machine_package", prompt="machine package", default=DEFAULT_PACKAGE
+    )
+
+
+def build_manager(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """reference: create/manager_triton.go:30-43."""
+    out = base_manager_config(ctx, "triton")
+    _triton_common(ctx, out)
+    _triton_instance(ctx, out)
+    return out
+
+
+def build_cluster(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """reference: create/cluster_triton.go:21-28."""
+    out = base_cluster_config(ctx, "triton")
+    _triton_common(ctx, out)
+    return out
+
+
+def build_node(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """reference: create/node_triton.go:26-40."""
+    out = base_node_config(ctx, "triton")
+    _triton_common(ctx, out)
+    _triton_instance(ctx, out)
+    return out
+
+
+register(
+    Provider(
+        name="triton",
+        display="Triton (Joyent/MNX)",
+        build_manager=build_manager,
+        build_cluster=build_cluster,
+        build_node=build_node,
+    )
+)

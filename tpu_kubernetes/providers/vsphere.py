@@ -1,0 +1,68 @@
+"""vSphere provider — cluster and node only, no manager.
+
+reference: the vsphere manager is commented out in the provider switch
+(create/manager.go:119); cluster/node at create/cluster_vsphere.go:18-30 and
+create/node_vsphere.go:20-35 (datacenter/datastore/resource pool/network/
+template, pure prompts — no SDK validation).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpu_kubernetes.providers.base import (
+    BuildContext,
+    Provider,
+    base_cluster_config,
+    base_node_config,
+    register,
+)
+
+
+def _vsphere_common(ctx: BuildContext, out: dict[str, Any]) -> None:
+    cfg = ctx.cfg
+    out["vsphere_server"] = cfg.get("vsphere_server", prompt="vSphere server")
+    out["vsphere_user"] = cfg.get("vsphere_user", prompt="vSphere user")
+    out["vsphere_password"] = cfg.get(
+        "vsphere_password", prompt="vSphere password", secret=True
+    )
+    out["vsphere_datacenter_name"] = cfg.get(
+        "vsphere_datacenter_name", prompt="datacenter"
+    )
+    out["vsphere_datastore_name"] = cfg.get(
+        "vsphere_datastore_name", prompt="datastore"
+    )
+    out["vsphere_resource_pool_name"] = cfg.get(
+        "vsphere_resource_pool_name", prompt="resource pool"
+    )
+    out["vsphere_network_name"] = cfg.get("vsphere_network_name", prompt="network")
+
+
+def build_cluster(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """reference: create/cluster_vsphere.go:18-30."""
+    out = base_cluster_config(ctx, "vsphere")
+    _vsphere_common(ctx, out)
+    return out
+
+
+def build_node(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
+    """reference: create/node_vsphere.go:20-35 — VMs cloned from a template."""
+    out = base_node_config(ctx, "vsphere")
+    _vsphere_common(ctx, out)
+    cfg = ctx.cfg
+    out["vsphere_template_name"] = cfg.get(
+        "vsphere_template_name", prompt="VM template to clone"
+    )
+    out["ssh_user"] = cfg.get("ssh_user", prompt="SSH user", default="ubuntu")
+    out["key_path"] = cfg.get("key_path", default="~/.ssh/id_rsa")
+    return out
+
+
+register(
+    Provider(
+        name="vsphere",
+        display="VMware vSphere (cluster/node only)",
+        build_cluster=build_cluster,
+        build_node=build_node,
+    )
+)

@@ -27,7 +27,7 @@ from pathlib import Path
 from typing import Any, Sequence
 
 from tpu_kubernetes.state import State
-from tpu_kubernetes.utils.trace import TRACER, Tracer
+from tpu_kubernetes.util.trace import TRACER, Tracer
 
 STATE_FILE = "main.tf.json"
 

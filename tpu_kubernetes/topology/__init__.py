@@ -2,6 +2,7 @@ from tpu_kubernetes.topology.tpu import (  # noqa: F401
     TopologyError,
     TpuTopology,
     parse_accelerator_type,
+    parse_mesh_shape,
     slice_host_env,
     validate_mesh,
 )

@@ -173,6 +173,26 @@ def _factor_topology(chips: int, is_3d: bool) -> str:
     return "x".join(str(d) for d in best)
 
 
+def parse_mesh_shape(spec: str) -> dict[str, int]:
+    """``"data=2,fsdp=8"`` → {"data": 2, "fsdp": 8}."""
+    out: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise TopologyError(
+                f"invalid mesh_shape entry {part!r}: expected axis=size"
+            )
+        axis, _, size = part.partition("=")
+        if not size.strip().isdigit():
+            raise TopologyError(
+                f"mesh_shape axis {axis.strip()!r} size must be an integer"
+            )
+        out[axis.strip()] = int(size)
+    return out
+
+
 def validate_mesh(topology: TpuTopology, mesh_shape: dict[str, int]) -> None:
     """Check a requested JAX mesh fits the slice **before** provisioning.
 

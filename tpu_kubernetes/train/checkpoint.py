@@ -27,10 +27,19 @@ def _import_ocp():
     return ocp
 
 
+def _resolve_dir(directory: str | Path):
+    """Absolute local path, or the unmodified URL for remote stores —
+    Path().absolute() would mangle gs://bucket/x into a local path."""
+    s = str(directory)
+    if "://" in s:
+        return s
+    return Path(directory).absolute()
+
+
 def _manager(directory: str | Path, max_to_keep: int = 3):
     ocp = _import_ocp()
     return ocp.CheckpointManager(
-        Path(directory).absolute(),
+        _resolve_dir(directory),
         options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
     )
 

@@ -1,0 +1,109 @@
+"""The in-tree training job (MaxText analog) — what ``kubectl apply`` runs
+on a TPU slice the framework provisioned.
+
+Flow (BASELINE.json north star): ``create cluster -p gcp-tpu`` stands up the
+slice and bakes the jax.distributed env onto every host
+(install_tpu_agent.sh.tpl); this job consumes that contract
+(parallel/distributed.py), builds a mesh over every chip in the slice, and
+trains the configured Llama over ICI. Step timing is logged so the driver
+can measure create→first-train-step latency (the north-star metric).
+
+Env knobs: JOB_MODEL (default llama-7b), JOB_BATCH (global), JOB_SEQ,
+JOB_STEPS, JOB_MESH ("data=1,fsdp=16,tensor=1"), JOB_CHECKPOINT_DIR,
+JOB_CHECKPOINT_EVERY.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def log(*args):
+    print("[job]", *args, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    from tpu_kubernetes.parallel import initialize
+
+    t_start = time.time()
+    denv = initialize()
+    log(f"process {denv.process_id}/{denv.num_processes} "
+        f"accelerator={denv.accelerator_type} topology={denv.slice_topology}")
+
+    from tpu_kubernetes.models import CONFIGS, param_count
+    from tpu_kubernetes.parallel import create_mesh, mesh_shape_for_devices
+    from tpu_kubernetes.train import (
+        TrainConfig,
+        init_state,
+        make_sharded_train_step,
+        synthetic_batches,
+    )
+    from tpu_kubernetes.train.checkpoint import CheckpointError, latest_step, restore, save
+
+    n = len(jax.devices())
+    model = os.environ.get("JOB_MODEL", "llama-7b")
+    cfg = CONFIGS[model]
+    batch = int(os.environ.get("JOB_BATCH", str(max(4, n))))
+    seq = int(os.environ.get("JOB_SEQ", str(cfg.max_seq)))
+    steps = int(os.environ.get("JOB_STEPS", "100"))
+    mesh_spec = os.environ.get("JOB_MESH", "")
+    ckpt_dir = os.environ.get("JOB_CHECKPOINT_DIR", "")
+    ckpt_every = int(os.environ.get("JOB_CHECKPOINT_EVERY", "50"))
+
+    from tpu_kubernetes.topology import parse_mesh_shape
+
+    shape = parse_mesh_shape(mesh_spec) if mesh_spec else mesh_shape_for_devices(n)
+    mesh = create_mesh(shape)
+    log(f"devices={n} mesh={dict(mesh.shape)} model={model} "
+        f"batch={batch} seq={seq}")
+
+    tc = TrainConfig()
+    state = init_state(jax.random.PRNGKey(0), cfg, tc)
+    log(f"params={param_count(state['params'])/1e9:.2f}B")
+    step_fn, shardings, b_sharding = make_sharded_train_step(cfg, tc, mesh, state)
+    state = jax.device_put(state, shardings)
+
+    start_step = 0
+    if ckpt_dir:
+        try:
+            found = latest_step(ckpt_dir)
+            if found is not None:
+                state = restore(ckpt_dir, like=state)
+                start_step = int(state["step"])
+                log(f"resumed from step {start_step}")
+        except CheckpointError as e:
+            log(f"no resume: {e}")
+
+    batches = synthetic_batches(cfg.vocab_size, batch, seq)
+    first_step_done = False
+    t_last = time.time()
+    for i in range(start_step, steps):
+        batch_arr = jax.device_put(next(batches), b_sharding)
+        state, loss = step_fn(state, batch_arr)
+        if not first_step_done:
+            jax.block_until_ready(loss)
+            log(f"FIRST TRAIN STEP at +{time.time() - t_start:.1f}s "
+                f"loss={float(loss):.4f}")   # the north-star latency marker
+            first_step_done = True
+        if (i + 1) % 10 == 0:
+            jax.block_until_ready(loss)
+            now = time.time()
+            tps = 10 * batch * seq / (now - t_last)
+            log(f"step={i + 1} loss={float(loss):.4f} tokens/s={tps:.0f}")
+            t_last = now
+        if ckpt_dir and (i + 1) % ckpt_every == 0:
+            # orbax save of cross-host sharded arrays is a collective —
+            # EVERY process must enter it (matching the restore path above)
+            save(ckpt_dir, state, step=i + 1)
+            if denv.process_id == 0:
+                log(f"checkpointed step {i + 1}")
+
+    log("done")
+
+
+if __name__ == "__main__":
+    main()

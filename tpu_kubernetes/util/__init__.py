@@ -4,3 +4,4 @@ from tpu_kubernetes.util.prompts import (  # noqa: F401
     Prompter,
     ScriptedPrompter,
 )
+from tpu_kubernetes.util.trace import TRACER, Span, Tracer  # noqa: F401
