@@ -1,4 +1,4 @@
-from tpu_kubernetes.backend.base import Backend, BackendError  # noqa: F401
+from tpu_kubernetes.backend.base import Backend, BackendError, LockError  # noqa: F401
 from tpu_kubernetes.backend.local import LocalBackend  # noqa: F401
 from tpu_kubernetes.backend.objectstore import (  # noqa: F401
     GCSStore,

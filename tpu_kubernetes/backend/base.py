@@ -10,6 +10,7 @@ framework's config).
 from __future__ import annotations
 
 import abc
+import contextlib
 from typing import Any
 
 from tpu_kubernetes.state import State
@@ -17,6 +18,10 @@ from tpu_kubernetes.state import State
 
 class BackendError(Exception):
     pass
+
+
+class LockError(BackendError):
+    """Raised when a state document's advisory lock is held elsewhere."""
 
 
 class Backend(abc.ABC):
@@ -44,3 +49,11 @@ class Backend(abc.ABC):
         """Return ``(document_path, config_obj)`` for the ``terraform.backend.*``
         block that co-locates terraform's tfstate with this backend.
         reference: backend/backend.go:24-26."""
+
+    def lock(self, name: str) -> contextlib.AbstractContextManager:
+        """Advisory per-manager lock, held by workflows across the whole
+        mutate → apply → persist window so two concurrent CLIs cannot
+        interleave edits to one document. The reference has no locking at all
+        (known TODO at backend/manta/backend.go:32); subclasses override.
+        Raises :class:`LockError` if held elsewhere and not stale."""
+        return contextlib.nullcontext()

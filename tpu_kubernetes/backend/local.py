@@ -11,16 +11,22 @@ constructor, which is what the tests use for hermeticity.
 
 from __future__ import annotations
 
+import contextlib
+import json
 import os
 import shutil
+import socket
+import time
+import uuid
 from pathlib import Path
 from typing import Any
 
-from tpu_kubernetes.backend.base import Backend, BackendError
+from tpu_kubernetes.backend.base import Backend, BackendError, LockError
 from tpu_kubernetes.state import State
 
 STATE_FILE = "main.tf.json"
 TFSTATE_FILE = "terraform.tfstate"
+LOCK_FILE = ".lock"
 
 
 def default_root() -> Path:
@@ -35,8 +41,13 @@ class LocalBackend(Backend):
 
     name = "local"
 
-    def __init__(self, root: str | Path | None = None):
+    def __init__(self, root: str | Path | None = None, lock_ttl_s: float = 3600.0):
         self.root = Path(root) if root is not None else default_root()
+        # TTL bounds one terraform apply / one interactive prompt session:
+        # the lock's clock is refreshed on every persist (right before and
+        # after apply), so only the gap between refreshes must fit in it
+        self.lock_ttl_s = lock_ttl_s
+        self._held: dict[str, str] = {}  # name → owner id, THIS instance's locks
 
     def _dir(self, name: str) -> Path:
         return self.root / name
@@ -55,11 +66,32 @@ class LocalBackend(Backend):
         return State(name)
 
     def persist_state(self, state: State) -> None:
+        self._refresh_held_lock(state.name)
         d = self._dir(state.name)
         d.mkdir(parents=True, exist_ok=True)
         tmp = d / (STATE_FILE + ".tmp")
         tmp.write_bytes(state.to_bytes())
         tmp.replace(d / STATE_FILE)
+
+    def _refresh_held_lock(self, name: str) -> None:
+        """If this instance holds ``name``'s lock, verify it wasn't stale-
+        broken by a contender (fail loudly rather than clobber their work)
+        and reset its TTL clock."""
+        owner = self._held.get(name)
+        if owner is None:
+            return
+        path = self._dir(name) / LOCK_FILE
+        try:
+            current = json.loads(path.read_bytes())
+        except (ValueError, OSError):
+            current = {}
+        if current.get("owner") != owner:
+            raise LockError(
+                f"lock on state {name!r} was lost mid-workflow "
+                "(broken as stale by another process?) — NOT persisting"
+            )
+        current["acquired_at"] = time.time()
+        path.write_bytes(json.dumps(current).encode())
 
     def delete_state(self, name: str) -> None:
         d = self._dir(name)
@@ -69,6 +101,56 @@ class LocalBackend(Backend):
     def state_terraform_config(self, name: str) -> tuple[str, Any]:
         tfstate = self._dir(name) / TFSTATE_FILE
         return "terraform.backend.local", {"path": str(tfstate)}
+
+    @contextlib.contextmanager
+    def lock(self, name: str):
+        """Lockfile with O_EXCL creation; stale locks (older than
+        ``lock_ttl_s``, e.g. a crashed apply) are broken. Release only deletes
+        a lock this context still owns, so a slow holder cannot delete its
+        successor's lock."""
+        path = self._dir(name) / LOCK_FILE
+        path.parent.mkdir(parents=True, exist_ok=True)
+        owner = uuid.uuid4().hex
+        payload = json.dumps(
+            {
+                "owner": owner,
+                "pid": os.getpid(),
+                "host": socket.gethostname(),
+                "acquired_at": time.time(),
+            }
+        ).encode()
+        # write-then-link so the lockfile is never visible without its payload
+        # (a contender reading a half-written lock must see it as HELD, not
+        # stale, or two holders could both enter)
+        tmp = path.with_name(f"{LOCK_FILE}.{owner}")
+        tmp.write_bytes(payload)
+        try:
+            os.link(tmp, path)  # atomic create; FileExistsError if held
+        except FileExistsError:
+            info: dict = {}
+            try:
+                info = json.loads(path.read_bytes())
+            except (ValueError, OSError):
+                info = {"acquired_at": time.time()}  # unreadable ⇒ assume held
+            if time.time() - info.get("acquired_at", time.time()) > self.lock_ttl_s:
+                path.write_bytes(payload)  # stale: break it (best-effort)
+            else:
+                raise LockError(
+                    f"state {name!r} is locked by pid {info.get('pid', '?')} on "
+                    f"{info.get('host', '?')} (delete {path} to force)"
+                ) from None
+        finally:
+            tmp.unlink(missing_ok=True)
+        self._held[name] = owner
+        try:
+            yield
+        finally:
+            self._held.pop(name, None)
+            try:
+                if json.loads(path.read_bytes()).get("owner") == owner:
+                    path.unlink()
+            except (ValueError, OSError):
+                pass
 
     def __repr__(self) -> str:
         return f"LocalBackend(root={str(self.root)!r})"

@@ -21,7 +21,7 @@ import json
 import time
 from typing import Any
 
-from tpu_kubernetes.backend.base import Backend, BackendError
+from tpu_kubernetes.backend.base import Backend, BackendError, LockError
 from tpu_kubernetes.state import State
 
 PREFIX = "tpu-kubernetes"
@@ -161,10 +161,14 @@ class ObjectStoreBackend(Backend):
 
     name = "gcs"
 
-    def __init__(self, store: ObjectStore, bucket: str = "", lock_ttl_s: float = 600.0):
+    def __init__(self, store: ObjectStore, bucket: str = "", lock_ttl_s: float = 3600.0):
         self.store = store
         self.bucket = bucket
+        # TTL must exceed the longest gap between lock refreshes; the lock is
+        # refreshed on every persist (i.e. right before and after apply), so
+        # it bounds one terraform apply / one interactive prompt session
         self.lock_ttl_s = lock_ttl_s
+        self._held: dict[str, str] = {}  # name → owner id, THIS instance's locks
 
     def _key(self, name: str, filename: str = STATE_FILE) -> str:
         return f"{PREFIX}/{name}/{filename}"
@@ -182,7 +186,30 @@ class ObjectStoreBackend(Backend):
         return State(name, data)
 
     def persist_state(self, state: State) -> None:
-        with self._lock(state.name):
+        owner = self._held.get(state.name)
+        if owner is not None:  # workflow already holds the lock
+            # verify we STILL hold it (a slow apply can overrun the TTL and be
+            # stale-broken by a contender; silently clobbering its document
+            # would be worse than failing loudly) — then refresh the TTL clock
+            key = self._key(state.name, LOCK_FILE)
+            current = self.store.get(key)
+            current_owner = None
+            if current is not None:
+                try:
+                    current_owner = json.loads(current).get("owner")
+                except (ValueError, AttributeError):
+                    pass
+            if current_owner != owner:
+                raise LockError(
+                    f"lock on state {state.name!r} was lost mid-workflow "
+                    "(broken as stale by another process?) — NOT persisting"
+                )
+            self.store.put(
+                key, json.dumps({"acquired_at": time.time(), "owner": owner}).encode()
+            )
+            self.store.put(self._key(state.name), state.to_bytes())
+            return
+        with self.lock(state.name):
             self.store.put(self._key(state.name), state.to_bytes())
 
     def delete_state(self, name: str) -> None:
@@ -199,7 +226,7 @@ class ObjectStoreBackend(Backend):
     # Best-effort: stale-lock breaking is not atomic (two breakers can race),
     # but each lock carries an owner id and release only deletes a lock this
     # process still owns — a slow holder cannot delete a successor's lock.
-    def _lock(self, name: str):
+    def lock(self, name: str):
         backend = self
 
         class _Lock:
@@ -211,10 +238,13 @@ class ObjectStoreBackend(Backend):
                 payload = json.dumps(
                     {"acquired_at": time.time(), "owner": self_inner.owner}
                 ).encode()
-                if backend.store.put_if_absent(key, payload):
-                    return self_inner
-                existing = backend.store.get(key)
-                if existing is not None:
+                for _ in range(2):  # one retry: holder may release mid-probe
+                    if backend.store.put_if_absent(key, payload):
+                        backend._held[name] = self_inner.owner
+                        return self_inner
+                    existing = backend.store.get(key)
+                    if existing is None:
+                        continue  # released between probe and read — retry
                     try:
                         acquired = json.loads(existing).get("acquired_at", 0)
                     except (ValueError, AttributeError):
@@ -222,13 +252,16 @@ class ObjectStoreBackend(Backend):
                     if time.time() - acquired > backend.lock_ttl_s:
                         # stale lock: break it (best-effort, see note above)
                         backend.store.put(key, payload)
+                        backend._held[name] = self_inner.owner
                         return self_inner
-                raise BackendError(
+                    break
+                raise LockError(
                     f"state {name!r} is locked by another process "
                     f"(delete {backend._key(name, LOCK_FILE)} to force)"
                 )
 
             def __exit__(self_inner, *exc):
+                backend._held.pop(name, None)
                 key = backend._key(name, LOCK_FILE)
                 current = backend.store.get(key)
                 if current is not None:

@@ -17,6 +17,7 @@ import tpu_kubernetes
 from tpu_kubernetes import create as create_wf
 from tpu_kubernetes import destroy as destroy_wf
 from tpu_kubernetes import get as get_wf
+from tpu_kubernetes import repair as repair_wf
 from tpu_kubernetes.backend import BackendError
 from tpu_kubernetes.config import Config, ConfigError
 from tpu_kubernetes.providers.base import ProviderError
@@ -64,6 +65,13 @@ def build_parser() -> argparse.ArgumentParser:
     get = sub.add_parser("get", help="query a manager or cluster")
     get.add_argument("kind", choices=["manager", "cluster"])
 
+    repair = sub.add_parser(
+        "repair",
+        help="re-apply a cluster after TPU preemption or node loss "
+             "(no reference analog)",
+    )
+    repair.add_argument("kind", choices=["cluster"])
+
     sub.add_parser("version", help="print the version")
     return parser
 
@@ -103,6 +111,11 @@ def main(argv: list[str] | None = None) -> int:
                 destroy_wf.delete_cluster(backend, cfg, executor)
             else:
                 destroy_wf.delete_node(backend, cfg, executor)
+        elif args.command == "repair":
+            print("Repairing cluster...")
+            keys = repair_wf.repair_cluster(backend, cfg, executor)
+            if keys:
+                print(f"Repaired {len(keys)} module(s).")
         elif args.command == "get":
             out = (
                 get_wf.get_manager(backend, cfg, executor)

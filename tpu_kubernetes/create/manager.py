@@ -36,24 +36,28 @@ def new_manager(backend: Backend, cfg: Config, executor: Executor) -> State:
     # name + dedupe (reference: create/manager.go:57-101)
     name = prompt_name(cfg, "name", "cluster manager name", backend.states())
 
-    state = backend.state(name)  # empty doc (reference: create/manager.go:103)
-    ctx = BuildContext(cfg=cfg, state=state, name=name)
-    with TRACER.phase("build manager config", provider=provider_name):
-        config = provider.build_manager(ctx, {})
-    state.set_manager(config)
+    # the lock (no reference analog — manta TODO :32) is held from the state
+    # READ through apply+persist, so a concurrent CLI can't build on a stale
+    # snapshot and silently drop this workflow's modules on persist
+    with backend.lock(name):
+        state = backend.state(name)  # empty doc (reference: create/manager.go:103)
+        ctx = BuildContext(cfg=cfg, state=state, name=name)
+        with TRACER.phase("build manager config", provider=provider_name):
+            config = provider.build_manager(ctx, {})
+        state.set_manager(config)
 
-    # confirm (reference: create/manager.go:127-138)
-    if not cfg.confirm(f"Create cluster manager {name!r} on {provider_name}?"):
-        raise ProviderError("aborted by user")
+        # confirm (reference: create/manager.go:127-138)
+        if not cfg.confirm(f"Create cluster manager {name!r} on {provider_name}?"):
+            raise ProviderError("aborted by user")
 
-    # co-locate terraform's own state (reference: create/manager.go:140)
-    path, tf_cfg = backend.state_terraform_config(name)
-    state.set_terraform_backend_config(path, tf_cfg)
+        # co-locate terraform's own state (reference: create/manager.go:140)
+        path, tf_cfg = backend.state_terraform_config(name)
+        state.set_terraform_backend_config(path, tf_cfg)
 
-    validate_document(state)  # render-time contract check (SURVEY §7 #5)
-    inject_root_outputs(state)  # root forwards so `get` can read module outputs
-    backend.persist_state(state)  # persist intent BEFORE apply (departure)
-    with TRACER.phase("apply manager", manager=name):
-        executor.apply(state)
-    backend.persist_state(state)  # reference: create/manager.go:148
+        validate_document(state)  # render-time contract check (SURVEY §7 #5)
+        inject_root_outputs(state)  # root forwards so `get` can read module outputs
+        backend.persist_state(state)  # persist intent BEFORE apply (departure)
+        with TRACER.phase("apply manager", manager=name):
+            executor.apply(state)
+        backend.persist_state(state)  # reference: create/manager.go:148
     return state

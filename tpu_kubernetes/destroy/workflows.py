@@ -8,26 +8,21 @@ node module).
 
 from __future__ import annotations
 
-import sys
-
 from tpu_kubernetes.backend import Backend
 from tpu_kubernetes.config import Config
 from tpu_kubernetes.create.node import select_cluster, select_manager
 from tpu_kubernetes.providers.base import ProviderError
 from tpu_kubernetes.shell import Executor
+from tpu_kubernetes.shell.executor import dry_run_skip
 from tpu_kubernetes.shell.outputs import inject_root_outputs
 from tpu_kubernetes.util.trace import TRACER
 
 
-def _is_dry_run(executor: Executor) -> bool:
-    return bool(getattr(executor, "dry_run", False))
-
-
-def _warn_dry_run(what: str) -> None:
-    print(
-        f"[tpu-k8s] dry-run: nothing was destroyed — keeping state for {what} "
+def _destroy_skipped(executor: Executor, what: str) -> bool:
+    return dry_run_skip(
+        executor,
+        f"nothing was destroyed — keeping state for {what} "
         "(re-run with terraform installed to actually destroy)",
-        file=sys.stderr,
     )
 
 
@@ -37,64 +32,66 @@ def delete_manager(backend: Backend, cfg: Config, executor: Executor) -> None:
     manager = select_manager(backend, cfg)
     if not cfg.confirm(f"Destroy cluster manager {manager!r} and ALL its clusters?"):
         raise ProviderError("aborted by user")
-    state = backend.state(manager)
-    with TRACER.phase("destroy manager", manager=manager):
-        executor.destroy(state)  # full destroy, no targets
-    if _is_dry_run(executor):
-        # never forget state for infrastructure that wasn't actually destroyed
-        _warn_dry_run(f"manager {manager!r}")
-        return
-    backend.delete_state(manager)
+    with backend.lock(manager):
+        state = backend.state(manager)
+        with TRACER.phase("destroy manager", manager=manager):
+            executor.destroy(state)  # full destroy, no targets
+        if _destroy_skipped(executor, f"manager {manager!r}"):
+            # never forget state for infrastructure that wasn't actually destroyed
+            return
+        backend.delete_state(manager)
 
 
 def delete_cluster(backend: Backend, cfg: Config, executor: Executor) -> None:
     """Targeted destroy of one cluster + its nodes.
     reference: destroy/cluster.go:16-161."""
     manager = select_manager(backend, cfg)
-    state = backend.state(manager)
-    cluster_key = select_cluster(state, cfg)
-    node_keys = sorted(state.nodes(cluster_key).values())
+    # lock held from the state READ through destroy+persist (see create/)
+    with backend.lock(manager):
+        state = backend.state(manager)
+        cluster_key = select_cluster(state, cfg)
+        node_keys = sorted(state.nodes(cluster_key).values())
 
-    if not cfg.confirm(
-        f"Destroy cluster {cluster_key} and its {len(node_keys)} node(s)?"
-    ):
-        raise ProviderError("aborted by user")
+        if not cfg.confirm(
+            f"Destroy cluster {cluster_key} and its {len(node_keys)} node(s)?"
+        ):
+            raise ProviderError("aborted by user")
 
-    # targets: the cluster module + one per node module
-    # (reference: destroy/cluster.go:126-138)
-    targets = [f"module.{cluster_key}"] + [f"module.{k}" for k in node_keys]
-    with TRACER.phase("destroy cluster", manager=manager, cluster=cluster_key):
-        executor.destroy(state, targets=targets)
-    if _is_dry_run(executor):
-        _warn_dry_run(f"cluster {cluster_key}")
-        return
+        # targets: the cluster module + one per node module
+        # (reference: destroy/cluster.go:126-138)
+        targets = [f"module.{cluster_key}"] + [f"module.{k}" for k in node_keys]
+        with TRACER.phase("destroy cluster", manager=manager, cluster=cluster_key):
+            executor.destroy(state, targets=targets)
+        if _destroy_skipped(executor, f"cluster {cluster_key}"):
+            return
 
-    # remove from the document (reference: destroy/cluster.go:147-158)
-    for key in [cluster_key, *node_keys]:
-        state.delete_module(key)
-    inject_root_outputs(state)  # drop forwards of deleted modules
-    backend.persist_state(state)
+        # remove from the document (reference: destroy/cluster.go:147-158)
+        for key in [cluster_key, *node_keys]:
+            state.delete_module(key)
+        inject_root_outputs(state)  # drop forwards of deleted modules
+        backend.persist_state(state)
 
 
 def delete_node(backend: Backend, cfg: Config, executor: Executor) -> None:
     """Targeted destroy of one node module. reference: destroy/node.go:16-180."""
     manager = select_manager(backend, cfg)
-    state = backend.state(manager)
-    cluster_key = select_cluster(state, cfg)
-    nodes = state.nodes(cluster_key)
-    if not nodes:
-        raise ProviderError(f"cluster {cluster_key} has no nodes")
-    hostname = cfg.get("hostname", prompt="node to destroy", choices=sorted(nodes))
-    node_key = nodes[hostname]
+    # lock held from the state READ through destroy+persist (see create/)
+    with backend.lock(manager):
+        state = backend.state(manager)
+        cluster_key = select_cluster(state, cfg)
+        nodes = state.nodes(cluster_key)
+        if not nodes:
+            raise ProviderError(f"cluster {cluster_key} has no nodes")
+        hostname = cfg.get("hostname", prompt="node to destroy", choices=sorted(nodes))
+        node_key = nodes[hostname]
 
-    if not cfg.confirm(f"Destroy node {node_key}?"):
-        raise ProviderError("aborted by user")
+        if not cfg.confirm(f"Destroy node {node_key}?"):
+            raise ProviderError("aborted by user")
 
-    with TRACER.phase("destroy node", manager=manager, node=node_key):
-        executor.destroy(state, targets=[f"module.{node_key}"])
-    if _is_dry_run(executor):
-        _warn_dry_run(f"node {node_key}")
-        return
-    state.delete_module(node_key)
-    inject_root_outputs(state)  # drop forwards of deleted modules
-    backend.persist_state(state)
+        with TRACER.phase("destroy node", manager=manager, node=node_key):
+            executor.destroy(state, targets=[f"module.{node_key}"])
+        if _destroy_skipped(executor, f"node {node_key}"):
+            return
+        state.delete_module(node_key)
+        inject_root_outputs(state)  # drop forwards of deleted modules
+        backend.persist_state(state)

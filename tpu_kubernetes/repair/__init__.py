@@ -1,0 +1,65 @@
+"""``repair cluster`` — preemption-aware slice recreate.
+
+No reference analog: the reference has no failure recovery at all (SURVEY
+§5.3 — its only resilience is that terraform state lets a failed apply be
+retried). Cloud TPU slices are preemptible and a v5p pod slice is one
+schedulable unit spanning several hosts, so a preempted slice must be
+re-created as a whole. This workflow re-applies a cluster's module set:
+
+* default — targeted ``terraform apply``; terraform's refresh notices
+  deleted/preempted machines and re-creates exactly those, an idempotent
+  no-op for healthy ones (the same property the reference leans on for
+  retries, rancher_cluster.sh:6,24-27).
+* ``replace_nodes`` — targeted ``terraform destroy`` of the node modules
+  first, then re-apply; for machines that are STOPPED-but-present (GCE/TPU
+  preemption leaves the resource visible, so refresh alone won't replace it).
+
+Holds the backend lock across the whole window, like every other mutation.
+"""
+
+from __future__ import annotations
+
+from tpu_kubernetes.backend import Backend
+from tpu_kubernetes.config import Config
+from tpu_kubernetes.create.node import select_cluster, select_manager
+from tpu_kubernetes.providers.base import ProviderError
+from tpu_kubernetes.shell import Executor
+from tpu_kubernetes.shell.executor import dry_run_skip
+from tpu_kubernetes.util.trace import TRACER
+
+__all__ = ["repair_cluster"]
+
+
+def repair_cluster(backend: Backend, cfg: Config, executor: Executor) -> list[str]:
+    """Re-apply one cluster's modules; returns the repaired module keys
+    (empty when running dry — nothing was actually repaired). The document
+    itself is never mutated, so there is nothing to persist."""
+    manager = select_manager(backend, cfg)
+    with backend.lock(manager):
+        state = backend.state(manager)
+        cluster_key = select_cluster(state, cfg)
+        node_keys = sorted(state.nodes(cluster_key).values())
+        replace = cfg.get_bool("replace_nodes", default=False)
+
+        action = "Replace the nodes of" if replace else "Repair"
+        if not cfg.confirm(
+            f"{action} cluster {cluster_key} ({len(node_keys)} node module(s))?"
+        ):
+            raise ProviderError("aborted by user")
+
+        # drive the executor even when dry — it renders/records the exact
+        # target set, so a dry repair surfaces what the real one would touch
+        targets = [f"module.{cluster_key}"] + [f"module.{k}" for k in node_keys]
+        node_targets = [f"module.{k}" for k in node_keys]
+        if replace and node_targets:
+            with TRACER.phase("replace: destroy nodes", cluster=cluster_key):
+                executor.destroy(state, targets=node_targets)
+        with TRACER.phase("repair apply", manager=manager, cluster=cluster_key):
+            executor.apply(state, targets=targets)
+        if dry_run_skip(
+            executor,
+            f"nothing was actually repaired for {cluster_key} "
+            "(re-run with terraform installed to really repair)",
+        ):
+            return []
+    return [cluster_key, *node_keys]

@@ -38,8 +38,10 @@ class ExecutorError(Exception):
 
 class Executor(abc.ABC):
     @abc.abstractmethod
-    def apply(self, state: State) -> None:
-        """terraform init + apply. reference: shell/run_terraform.go:11-44."""
+    def apply(self, state: State, targets: Sequence[str] = ()) -> None:
+        """terraform init + apply [-target=module.X …].
+        reference: shell/run_terraform.go:11-44 (the reference never targets
+        an apply; ``repair cluster`` — no reference analog — does)."""
 
     @abc.abstractmethod
     def destroy(self, state: State, targets: Sequence[str] = ()) -> None:
@@ -101,13 +103,15 @@ class TerraformExecutor(Executor):
             )
         return proc.stdout
 
-    def apply(self, state: State) -> None:
+    def apply(self, state: State, targets: Sequence[str] = ()) -> None:
         with tempfile.TemporaryDirectory(prefix="tpu-k8s-") as d:
             render_to_dir(state, d)
             with self.tracer.phase("terraform init", manager=state.name):
                 self._run(["init", "-force-copy"], Path(d))
+            args = ["apply", "-auto-approve"]
+            args += [f"-target={t}" for t in targets]
             with self.tracer.phase("terraform apply", manager=state.name):
-                self._run(["apply", "-auto-approve"], Path(d))
+                self._run(args, Path(d))
 
     def destroy(self, state: State, targets: Sequence[str] = ()) -> None:
         with tempfile.TemporaryDirectory(prefix="tpu-k8s-") as d:
@@ -166,8 +170,8 @@ class FakeExecutor(Executor):
             raise ExecutorError(self.fail_with)
         self.calls.append(call)
 
-    def apply(self, state: State) -> None:
-        self._record(RecordedCall("apply", state.to_dict()))
+    def apply(self, state: State, targets: Sequence[str] = ()) -> None:
+        self._record(RecordedCall("apply", state.to_dict(), targets=tuple(targets)))
 
     def destroy(self, state: State, targets: Sequence[str] = ()) -> None:
         self._record(RecordedCall("destroy", state.to_dict(), targets=tuple(targets)))
@@ -175,6 +179,18 @@ class FakeExecutor(Executor):
     def output(self, state: State, module_key: str) -> dict[str, Any]:
         self._record(RecordedCall("output", state.to_dict(), module_key=module_key))
         return self.outputs.get(module_key, {})
+
+
+def dry_run_skip(executor: Executor, message: str) -> bool:
+    """True (with a stderr warning) when ``executor`` is a dry-run stand-in
+    for missing terraform — callers use it to skip state side-effects for
+    infrastructure that was never actually touched."""
+    if not getattr(executor, "dry_run", False):
+        return False
+    import sys
+
+    print(f"[tpu-k8s] dry-run: {message}", file=sys.stderr)
+    return True
 
 
 def default_executor() -> Executor:
