@@ -1,11 +1,41 @@
-"""tpu_kubernetes.models — model family for the in-tree training stack."""
+"""tpu_kubernetes.models — model family for the in-tree training stack.
 
-from tpu_kubernetes.models.llama import (  # noqa: F401
-    CONFIGS,
-    ModelConfig,
-    forward,
-    init_params,
-    logical_axes,
-    loss_fn,
-    param_count,
-)
+Two members share one functional API (init_params / forward / loss_fn /
+logical_axes, all taking the config last or as ``cfg=``):
+
+* dense LLaMA decoders (models/llama.py) — the flagship (north-star trains
+  Llama-7B on a v5p-32 slice);
+* sparse Mixture-of-Experts decoders (models/moe.py) — the
+  expert-parallel member.
+
+The top-level functions here dispatch on the config type, so the trainer
+and bench are family-agnostic.
+"""
+
+from tpu_kubernetes.models import llama as _llama
+from tpu_kubernetes.models import moe as _moe
+from tpu_kubernetes.models.llama import ModelConfig  # noqa: F401
+from tpu_kubernetes.models.llama import param_count  # noqa: F401
+from tpu_kubernetes.models.moe import MoEConfig, expert_capacity  # noqa: F401
+
+CONFIGS: dict[str, ModelConfig] = {**_llama.CONFIGS, **_moe.MOE_CONFIGS}
+
+
+def _family(cfg: ModelConfig):
+    return _moe if isinstance(cfg, MoEConfig) else _llama
+
+
+def init_params(rng, cfg: ModelConfig) -> dict:
+    return _family(cfg).init_params(rng, cfg)
+
+
+def logical_axes(cfg: ModelConfig) -> dict:
+    return _family(cfg).logical_axes(cfg)
+
+
+def forward(params: dict, tokens, cfg: ModelConfig):
+    return _family(cfg).forward(params, tokens, cfg)
+
+
+def loss_fn(params: dict, tokens, cfg: ModelConfig):
+    return _family(cfg).loss_fn(params, tokens, cfg)

@@ -25,7 +25,13 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from tpu_kubernetes.ops import apply_rope, flash_attention, rms_norm, rope_frequencies
+from tpu_kubernetes.ops import (
+    apply_rope,
+    flash_attention,
+    next_token_nll,
+    rms_norm,
+    rope_frequencies,
+)
 
 
 @dataclass(frozen=True)
@@ -138,12 +144,12 @@ def param_count(params: dict) -> int:
 
 # -- forward ----------------------------------------------------------------
 
-def _block(cfg: ModelConfig, cos, sin, x, layer):
-    """One transformer block. x: (batch, seq, d_model)."""
+def attention_sublayer(cfg: ModelConfig, cos, sin, x, layer):
+    """Pre-norm attention + residual. x: (batch, seq, d_model). Shared by
+    the dense (this file) and MoE (models/moe.py) block variants."""
     b, s, d = x.shape
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
-    # attention
     y = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
     q = (y @ layer["wq"]).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
     k = (y @ layer["wk"]).reshape(b, s, kv, hd).transpose(0, 2, 1, 3)
@@ -160,7 +166,12 @@ def _block(cfg: ModelConfig, cos, sin, x, layer):
         use_pallas=cfg.use_pallas,
     )
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
-    x = x + attn @ layer["wo"]
+    return x + attn @ layer["wo"]
+
+
+def _block(cfg: ModelConfig, cos, sin, x, layer):
+    """One transformer block. x: (batch, seq, d_model)."""
+    x = attention_sublayer(cfg, cos, sin, x, layer)
 
     # SwiGLU MLP
     y = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
@@ -185,7 +196,4 @@ def forward(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
 def loss_fn(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
     """Next-token cross-entropy over (batch, seq) tokens."""
     logits = forward(params, tokens[:, :-1], cfg)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return next_token_nll(logits, tokens[:, 1:])

@@ -1,0 +1,251 @@
+"""Mixtral-style sparse Mixture-of-Experts decoder — the expert-parallel
+member of the in-tree model family.
+
+The attention path is shared with the dense model
+(models/llama.py:attention_sublayer); the SwiGLU MLP is replaced by a
+GShard-style top-k routed expert layer. TPU-first design:
+
+* **Static shapes everywhere**: routing uses expert-capacity
+  dispatch/combine one-hot tensors (no gather/scatter, no dynamic shapes),
+  so the whole layer is three einsums XLA maps straight onto the MXU.
+* **Expert parallelism**: expert weights carry an ``expert`` logical axis
+  which parallel/mesh.py maps to the ``expert`` mesh axis. Token
+  activations are sharded over the data-like axes (which include
+  ``expert`` — GShard's trick of reusing the expert axis for data
+  parallelism in the non-MoE path), so XLA inserts the all-to-all on the
+  dispatch/combine einsums and it rides ICI.
+* ``lax.scan`` over stacked layer params, exactly like the dense model:
+  expert weights are stacked (n_layers, n_experts, ...) so one block is
+  traced/compiled once.
+* Load-balancing auxiliary loss (Switch-style f·P) computed in float32 and
+  added by :func:`loss_fn` with coefficient ``router_aux_coef``.
+
+The reference provisioner has no model code at all; this is part of the
+framework's in-tree example-job stack (SURVEY.md §2.7 — DP/…/EP are
+"delivered via the in-tree example"), giving the ``expert`` mesh axis a
+real consumer.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from tpu_kubernetes.models.llama import (
+    ModelConfig,
+    _dense_init,
+    attention_sublayer,
+)
+from tpu_kubernetes.ops import next_token_nll, rms_norm, rope_frequencies
+
+
+@dataclass(frozen=True)
+class MoEConfig(ModelConfig):
+    n_experts: int = 8
+    experts_per_token: int = 2
+    # per-expert token capacity = ceil(k · seq · capacity_factor / n_experts)
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
+
+
+MOE_CONFIGS: dict[str, MoEConfig] = {
+    "moe-test": MoEConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=128, remat=False, n_experts=4, experts_per_token=2,
+    ),
+    "moe-1b": MoEConfig(
+        vocab_size=32000, d_model=1024, n_layers=12, n_heads=16, n_kv_heads=8,
+        d_ff=3584, max_seq=2048, n_experts=8, experts_per_token=2,
+    ),
+    "mixtral-8x7b": MoEConfig(
+        vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_ff=14336, max_seq=4096, rope_theta=1e6, n_experts=8,
+        experts_per_token=2,
+    ),
+}
+
+
+def expert_capacity(cfg: MoEConfig, seq: int) -> int:
+    return max(
+        1,
+        math.ceil(
+            cfg.experts_per_token * seq * cfg.capacity_factor / cfg.n_experts
+        ),
+    )
+
+
+# -- params -----------------------------------------------------------------
+
+def init_params(rng: jax.Array, cfg: MoEConfig) -> dict:
+    """Parameter pytree; layer params stacked on a leading axis for
+    lax.scan, expert weights additionally stacked on an expert axis."""
+    keys = jax.random.split(rng, 10)
+    d, h, kv, hd, ff, L, E = (
+        cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim,
+        cfg.d_ff, cfg.n_layers, cfg.n_experts,
+    )
+
+    def stack_init(key, shape, fan_in):
+        ks = jax.random.split(key, L)
+        return jnp.stack([_dense_init(k, shape, cfg.dtype, fan_in) for k in ks])
+
+    return {
+        "embed": _dense_init(keys[0], (cfg.vocab_size, d), cfg.dtype, 1.0),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), cfg.dtype),
+            "wq": stack_init(keys[1], (d, h * hd), d),
+            "wk": stack_init(keys[2], (d, kv * hd), d),
+            "wv": stack_init(keys[3], (d, kv * hd), d),
+            "wo": stack_init(keys[4], (h * hd, d), h * hd),
+            "mlp_norm": jnp.ones((L, d), cfg.dtype),
+            # router in float32 — routing decisions are precision-sensitive
+            "w_router": jnp.stack([
+                (jax.random.normal(k, (d, E), jnp.float32) / jnp.sqrt(d))
+                for k in jax.random.split(keys[5], L)
+            ]),
+            "w_gate": stack_init(keys[6], (E, d, ff), d),
+            "w_up": stack_init(keys[7], (E, d, ff), d),
+            "w_down": stack_init(keys[8], (E, ff, d), ff),
+        },
+        "final_norm": jnp.ones((d,), cfg.dtype),
+        "lm_head": _dense_init(keys[9], (d, cfg.vocab_size), cfg.dtype, d),
+    }
+
+
+def logical_axes(cfg: MoEConfig) -> dict:
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layer", "embed"),
+            "wq": ("layer", "embed", "heads"),
+            "wk": ("layer", "embed", "kv"),
+            "wv": ("layer", "embed", "kv"),
+            "wo": ("layer", "heads", "embed"),
+            "mlp_norm": ("layer", "embed"),
+            "w_router": ("layer", "embed", None),
+            "w_gate": ("layer", "expert", "embed", "mlp"),
+            "w_up": ("layer", "expert", "embed", "mlp"),
+            "w_down": ("layer", "expert", "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+# -- routed expert layer ----------------------------------------------------
+
+def _route(gates: jax.Array, k: int, capacity: int):
+    """Top-k expert-capacity routing. gates: (b, s, E) float32 softmax
+    probabilities → (dispatch, combine) both (b, s, E, C), plus the
+    first-choice mask (b, s, E) for the load-balance loss.
+
+    Two passes, k a small static int. Pass 1 picks the k choice masks
+    (argmax, mask out, repeat) — these depend only on each token's own
+    gates. Pass 2 assigns capacity slots with a single exclusive cumsum
+    over the sequence, ordering claims lexicographically by (position,
+    round), so slot assignment — and therefore overflow dropping — is
+    **causal**: whether a token is kept depends only on tokens before it,
+    never on later ones (plain GShard offsets round-2 slots by whole-batch
+    round-1 counts and silently leaks future positions into the drop
+    pattern). Dropped tokens pass through on the residual; combine weights
+    are renormalized over the *selected* experts (Mixtral semantics)."""
+    b, s, E = gates.shape
+    remaining = gates
+    masks = []
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)              # (b, s)
+        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # (b, s, E)
+        masks.append(mask)
+        remaining = remaining * (1.0 - mask)
+    first_mask = masks[0]
+
+    # claims on each expert by strictly earlier tokens (any round)
+    total = sum(masks)
+    earlier = jnp.cumsum(total, axis=1) - total           # exclusive cumsum
+
+    dispatch = jnp.zeros((b, s, E, capacity), jnp.float32)
+    combine = jnp.zeros((b, s, E, capacity), jnp.float32)
+    selected_sum = jnp.zeros((b, s), jnp.float32)
+    same_token = jnp.zeros((b, s, E), jnp.float32)        # earlier rounds, same token
+    for mask in masks:
+        pos = earlier + same_token
+        keep = mask * (pos < capacity)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+        sel = keep[..., None] * slot                      # (b, s, E, C)
+        gate_i = jnp.sum(gates * mask, axis=-1)           # (b, s)
+        dispatch = dispatch + sel
+        combine = combine + sel * gate_i[..., None, None]
+        selected_sum = selected_sum + gate_i
+        same_token = same_token + mask
+
+    combine = combine / jnp.maximum(selected_sum, 1e-9)[..., None, None]
+    return dispatch, combine, first_mask
+
+
+def moe_sublayer(cfg: MoEConfig, x, layer):
+    """Pre-norm routed-expert MLP + residual. x: (b, s, d) → (out, aux)."""
+    b, s, d = x.shape
+    C = expert_capacity(cfg, s)
+    y = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+
+    logits = jnp.einsum(
+        "bsd,de->bse", y.astype(jnp.float32), layer["w_router"]
+    )
+    gates = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine, first = _route(gates, cfg.experts_per_token, C)
+
+    # dispatch → per-expert token buckets (all-to-all over the expert axis
+    # when sharded); compute in model dtype on the MXU
+    xe = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(cfg.dtype), y)
+    gated = jax.nn.silu(
+        jnp.einsum("ebcd,edf->ebcf", xe, layer["w_gate"])
+    ) * jnp.einsum("ebcd,edf->ebcf", xe, layer["w_up"])
+    out_e = jnp.einsum("ebcf,efd->ebcd", gated, layer["w_down"])
+    out = jnp.einsum("ebcd,bsec->bsd", out_e, combine.astype(cfg.dtype))
+
+    # Switch-style load-balance loss: n_experts · Σ_e f_e · P_e, where f_e
+    # is the fraction of tokens whose FIRST choice is e, P_e the mean
+    # router probability of e
+    f = jnp.mean(first, axis=(0, 1))
+    p = jnp.mean(gates, axis=(0, 1))
+    aux = cfg.n_experts * jnp.sum(f * p)
+    return x + out, aux
+
+
+# -- forward ----------------------------------------------------------------
+
+def _block(cfg: MoEConfig, cos, sin, x, layer):
+    x = attention_sublayer(cfg, cos, sin, x, layer)
+    return moe_sublayer(cfg, x, layer)
+
+
+def forward_with_aux(
+    params: dict, tokens: jax.Array, cfg: MoEConfig
+) -> tuple[jax.Array, jax.Array]:
+    """tokens (b, s) int32 → (logits (b, s, vocab) f32, mean aux loss)."""
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    x = params["embed"][tokens]
+
+    def block(x, layer):
+        x, aux = _block(cfg, cos, sin, x, layer)
+        return x, aux
+
+    if cfg.remat:
+        block = jax.checkpoint(block)
+    x, aux = jax.lax.scan(block, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32), jnp.mean(aux)
+
+
+def forward(params: dict, tokens: jax.Array, cfg: MoEConfig) -> jax.Array:
+    return forward_with_aux(params, tokens, cfg)[0]
+
+
+def loss_fn(params: dict, tokens: jax.Array, cfg: MoEConfig) -> jax.Array:
+    """Next-token cross-entropy + router load-balance auxiliary loss."""
+    logits, aux = forward_with_aux(params, tokens[:, :-1], cfg)
+    return next_token_nll(logits, tokens[:, 1:]) + cfg.router_aux_coef * aux

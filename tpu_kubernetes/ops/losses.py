@@ -1,0 +1,15 @@
+"""Shared loss primitives for the in-tree model family."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def next_token_nll(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean next-token cross-entropy. logits: (batch, seq, vocab) float32,
+    targets: (batch, seq) int32. The single definition used by the dense,
+    MoE, and pipelined loss functions."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)

@@ -15,6 +15,11 @@ from tpu_kubernetes.parallel.mesh import (  # noqa: F401
     mesh_shape_for_devices,
     param_shardings,
 )
+from tpu_kubernetes.parallel.pipeline import (  # noqa: F401
+    pipeline_forward,
+    pipeline_loss_fn,
+    pipeline_param_shardings,
+)
 from tpu_kubernetes.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ring_attention_sharded,

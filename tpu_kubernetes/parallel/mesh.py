@@ -10,6 +10,10 @@ let XLA insert the collectives. Axes:
              per-block; innermost, fastest ICI axis)
   sequence — context parallelism for long sequences (ring attention,
              parallel/ring_attention.py)
+  expert   — expert parallelism for MoE models (models/moe.py); doubles as
+             a data axis for the non-MoE path, GShard-style
+  stage    — pipeline parallelism (parallel/pipeline.py); layers split
+             into stages, microbatches flow stage-to-stage over ppermute
 
 Logical param/activation axes (models/llama.py logical_axes) map to mesh
 axes through RULES; the same model code runs on any mesh shape.
@@ -24,7 +28,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-MESH_AXES = ("data", "fsdp", "tensor", "sequence")
+MESH_AXES = ("data", "fsdp", "expert", "stage", "tensor", "sequence")
 
 # logical axis → mesh axis (None = replicate). The fsdp axis shards the
 # embed dimension of every weight (ZeRO-3-style); tensor shards heads/mlp.
@@ -36,9 +40,23 @@ DEFAULT_RULES: dict[str, str | None] = {
     "kv": "tensor",
     "mlp": "tensor",
     "vocab": "tensor",
-    "layer": None,            # scan axis is never sharded
+    "layer": None,            # scan axis is never sharded (pipeline shards
+                              # it over "stage" via pipeline_param_shardings)
     "seq": "sequence",
+    "expert": "expert",       # MoE expert weights over the expert axis
 }
+
+# mesh axes that carry the batch (data-like); "expert" is data-like for the
+# non-MoE path, GShard-style (the same devices that hold different experts
+# also hold different tokens, so the dispatch einsum becomes an all-to-all)
+DATA_AXES = ("data", "fsdp", "expert")
+
+
+def data_axes_in(mesh: Mesh) -> tuple[str, ...]:
+    """The data-like axes actually present (and non-trivial) in a mesh."""
+    return tuple(
+        a for a in DATA_AXES if a in mesh.axis_names and mesh.shape[a] > 1
+    )
 
 
 def create_mesh(
@@ -92,10 +110,8 @@ def param_shardings(
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Input batch: sharded over every data-like axis present (data × fsdp)."""
-    data_axes = tuple(
-        a for a in ("data", "fsdp") if a in mesh.axis_names and mesh.shape[a] > 1
-    )
+    """Input batch: sharded over every data-like axis present (DATA_AXES)."""
+    data_axes = data_axes_in(mesh)
     if not data_axes:
         return NamedSharding(mesh, PartitionSpec())
     return NamedSharding(mesh, PartitionSpec(data_axes))

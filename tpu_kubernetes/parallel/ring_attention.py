@@ -21,7 +21,10 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
-from jax.experimental.shard_map import shard_map
+try:  # jax ≥ 0.8 top-level export; fall back for older
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
 
 NEG_INF = -1e30
 

@@ -5,6 +5,7 @@ from tpu_kubernetes.train.trainer import (  # noqa: F401
     TrainConfig,
     init_state,
     make_optimizer,
+    make_pipeline_train_step,
     make_sharded_train_step,
     state_shardings,
     synthetic_batches,

@@ -50,24 +50,33 @@ def init_state(
 
 
 def train_step(
-    state: dict[str, Any], batch: jax.Array, cfg: ModelConfig, tc: TrainConfig
+    state: dict[str, Any], batch: jax.Array, cfg: ModelConfig, tc: TrainConfig,
+    loss: Callable | None = None,
 ) -> tuple[dict[str, Any], jax.Array]:
-    """One optimizer step. batch: (per-global-batch, seq+1) int32 tokens."""
-    loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch, cfg)
+    """One optimizer step. batch: (per-global-batch, seq+1) int32 tokens.
+    ``loss`` defaults to the model family's loss_fn; the pipelined step
+    passes pipeline_loss_fn here — the optimizer/update logic is shared."""
+    loss_value, grads = jax.value_and_grad(loss or loss_fn)(
+        state["params"], batch, cfg
+    )
     updates, new_opt = make_optimizer(tc).update(
         grads, state["opt_state"], state["params"]
     )
     new_params = optax.apply_updates(state["params"], updates)
     return (
         {"params": new_params, "opt_state": new_opt, "step": state["step"] + 1},
-        loss,
+        loss_value,
     )
 
 
-def state_shardings(state: dict[str, Any], cfg: ModelConfig, mesh: Mesh) -> Any:
-    """Shardings for the whole train state: params by logical axes; optimizer
-    moments follow their parameters; scalars replicated."""
-    p_shardings = param_shardings(logical_axes(cfg), mesh)
+def state_shardings(
+    state: dict[str, Any], cfg: ModelConfig, mesh: Mesh, p_shardings: Any = None
+) -> Any:
+    """Shardings for the whole train state: params by logical axes (or the
+    given pytree, e.g. pipeline shardings); optimizer moments follow their
+    parameters; scalars replicated."""
+    if p_shardings is None:
+        p_shardings = param_shardings(logical_axes(cfg), mesh)
     replicated = NamedSharding(mesh, PartitionSpec())
 
     # match opt_state structure by mapping over it with params-shaped
@@ -96,19 +105,42 @@ def state_shardings(state: dict[str, Any], cfg: ModelConfig, mesh: Mesh) -> Any:
 
 
 def make_sharded_train_step(
-    cfg: ModelConfig, tc: TrainConfig, mesh: Mesh, state: dict[str, Any]
+    cfg: ModelConfig, tc: TrainConfig, mesh: Mesh, state: dict[str, Any],
+    loss: Callable | None = None, p_shardings: Any = None,
 ) -> tuple[Callable, Any, NamedSharding]:
     """→ (jitted step, state shardings, batch sharding). The returned step
     donates the state buffer (in-place update on device)."""
-    shardings = state_shardings(state, cfg, mesh)
+    shardings = state_shardings(state, cfg, mesh, p_shardings=p_shardings)
     b_sharding = batch_sharding(mesh)
     step = jax.jit(
-        functools.partial(train_step, cfg=cfg, tc=tc),
+        functools.partial(train_step, cfg=cfg, tc=tc, loss=loss),
         in_shardings=(shardings, b_sharding),
         out_shardings=(shardings, NamedSharding(mesh, PartitionSpec())),
         donate_argnums=(0,),
     )
     return step, shardings, b_sharding
+
+
+def make_pipeline_train_step(
+    cfg: ModelConfig, tc: TrainConfig, mesh: Mesh, state: dict[str, Any],
+    n_microbatches: int,
+) -> tuple[Callable, Any, NamedSharding]:
+    """→ (jitted pipelined step, state shardings, batch sharding). Layer
+    params live sharded over the ``stage`` mesh axis; the batch shards
+    over the data axes (PP × DP in one program). A thin specialization of
+    make_sharded_train_step: same optimizer/update path, pipelined loss."""
+    from tpu_kubernetes.parallel.pipeline import (
+        pipeline_loss_fn,
+        pipeline_param_shardings,
+    )
+
+    return make_sharded_train_step(
+        cfg, tc, mesh, state,
+        loss=functools.partial(
+            pipeline_loss_fn, mesh=mesh, n_microbatches=n_microbatches
+        ),
+        p_shardings=pipeline_param_shardings(logical_axes(cfg), mesh),
+    )
 
 
 def synthetic_batches(
