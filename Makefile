@@ -3,10 +3,15 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast bench dryrun dist clean
+.PHONY: test test-fast bench dryrun native dist clean
 
 test:
 	python -m pytest tests/ -q
+
+# Build the native C++ runtime layer eagerly (it also auto-builds on first
+# use into ~/.tpu-kubernetes/native, cached by source hash).
+native:
+	python -c "from tpu_kubernetes import native; assert native.available(), 'native build failed'; print('native runtime OK')"
 
 test-fast:
 	python -m pytest tests/ -q -m "not slow"

@@ -1,0 +1,233 @@
+// tk_runtime — native runtime layer for the tpu-kubernetes CLI.
+//
+// The reference framework's runtime is a compiled (Go) binary whose
+// execution layer streams a subprocess's output through to the operator
+// (reference: shell/run_shell_cmd.go:8-13, run_terraform.go:11-80). This
+// is the C++ equivalent for the rebuild: a line-streaming process runner
+// with monotonic-deadline timeout enforcement and a tail capture for
+// error reporting, plus flock(2)-based advisory locking used by the local
+// state backend to make its stale-lock-break critical section atomic on a
+// host. Exposed to Python over a minimal C ABI via ctypes
+// (tpu_kubernetes/native/__init__.py) — no pybind11 dependency.
+//
+// Build: make native   (g++ -O2 -shared -fPIC)
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <sys/file.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+namespace {
+
+// Child pgid of the in-flight run (one run at a time per process — the
+// executor is sequential). SIGINT/SIGTERM are forwarded to the child's
+// process group while a run is active: the child runs in its own pgrp (so
+// a deadline kill reaps grandchildren), which takes it out of the
+// terminal's foreground group — without forwarding, Ctrl-C could no
+// longer interrupt a wedged terraform apply.
+volatile pid_t g_child_pgid = 0;
+
+void forward_signal(int sig) {
+  const pid_t p = g_child_pgid;
+  if (p > 0) kill(-p, sig);
+}
+
+double monotonic_now() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<double>(ts.tv_sec) + static_cast<double>(ts.tv_nsec) * 1e-9;
+}
+
+// Keep the last tail_cap-1 bytes of output for error messages.
+void append_tail(char *tail, int tail_cap, int *tail_len, const char *buf,
+                 ssize_t n) {
+  if (tail == nullptr || tail_cap <= 1) return;
+  const int cap = tail_cap - 1;  // reserve NUL
+  if (n >= cap) {
+    memcpy(tail, buf + (n - cap), cap);
+    *tail_len = cap;
+  } else if (*tail_len + n <= cap) {
+    memcpy(tail + *tail_len, buf, n);
+    *tail_len += static_cast<int>(n);
+  } else {
+    const int keep = cap - static_cast<int>(n);
+    memmove(tail, tail + (*tail_len - keep), keep);
+    memcpy(tail + keep, buf, n);
+    *tail_len = cap;
+  }
+  tail[*tail_len] = '\0';
+}
+
+}  // namespace
+
+extern "C" {
+
+// Exit-code space: >=0 child exit status; TK_ERR_SPAWN spawn failure;
+// TK_ERR_TIMEOUT killed on deadline; TK_ERR_SIGNAL child died on a signal;
+// TK_ERR_INTERNAL pipe/fork plumbing failure.
+enum {
+  TK_ERR_SPAWN = -1,
+  TK_ERR_TIMEOUT = -2,
+  TK_ERR_SIGNAL = -3,
+  TK_ERR_INTERNAL = -4,
+};
+
+// Run argv (NULL-terminated) in cwd (may be NULL), merging the child's
+// stdout+stderr through one pipe. When stream != 0 every chunk is echoed
+// to our stdout as it arrives (the operator watches terraform progress
+// live). The last bytes are kept in tail/tail_cap for error reporting.
+// timeout_s <= 0 means no deadline; on expiry the whole child process
+// group gets SIGKILL.
+int tk_run_streaming(const char *const argv[], const char *cwd,
+                     double timeout_s, int stream, char *tail, int tail_cap) {
+  int tail_len = 0;
+  if (tail != nullptr && tail_cap > 0) tail[0] = '\0';
+
+  int pipefd[2];
+  if (pipe(pipefd) != 0) return TK_ERR_INTERNAL;
+
+  const pid_t pid = fork();
+  if (pid < 0) {
+    close(pipefd[0]);
+    close(pipefd[1]);
+    return TK_ERR_INTERNAL;
+  }
+
+  if (pid == 0) {  // child
+    setpgid(0, 0);  // own process group so a timeout kill reaps grandchildren
+    close(pipefd[0]);
+    dup2(pipefd[1], STDOUT_FILENO);
+    dup2(pipefd[1], STDERR_FILENO);
+    close(pipefd[1]);
+    if (cwd != nullptr && chdir(cwd) != 0) _exit(127);
+    execvp(argv[0], const_cast<char *const *>(argv));
+    // exec failed — report over the (now-dup2'd) pipe and die with the
+    // shell's command-not-found status
+    fprintf(stderr, "tk_runtime: exec %s: %s\n", argv[0], strerror(errno));
+    _exit(127);
+  }
+
+  // parent: forward terminal signals to the child's process group for the
+  // duration of the run (see g_child_pgid above)
+  close(pipefd[1]);
+  g_child_pgid = pid;
+  struct sigaction fwd = {}, old_int = {}, old_term = {};
+  fwd.sa_handler = forward_signal;
+  sigemptyset(&fwd.sa_mask);
+  sigaction(SIGINT, &fwd, &old_int);
+  sigaction(SIGTERM, &fwd, &old_term);
+
+  const double deadline =
+      timeout_s > 0 ? monotonic_now() + timeout_s : 0.0;
+  bool timed_out = false;
+  char buf[8192];
+
+  for (;;) {
+    int poll_ms = -1;
+    if (deadline > 0) {
+      const double left = deadline - monotonic_now();
+      if (left <= 0) {
+        timed_out = true;
+        break;
+      }
+      poll_ms = static_cast<int>(left * 1000.0) + 1;
+    }
+    struct pollfd pfd = {pipefd[0], POLLIN, 0};
+    const int pr = poll(&pfd, 1, poll_ms);
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    if (pr == 0) {  // poll timeout — deadline passed
+      timed_out = true;
+      break;
+    }
+    const ssize_t n = read(pipefd[0], buf, sizeof buf);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    if (n == 0) break;  // EOF — child closed its end
+    if (stream) {
+      ssize_t off = 0;
+      while (off < n) {
+        const ssize_t w = write(STDOUT_FILENO, buf + off, n - off);
+        if (w <= 0) break;
+        off += w;
+      }
+    }
+    append_tail(tail, tail_cap, &tail_len, buf, n);
+  }
+  close(pipefd[0]);
+
+  if (timed_out) {
+    kill(-pid, SIGKILL);  // the whole process group
+    kill(pid, SIGKILL);
+  }
+
+  int status = 0;
+  int wait_err = 0;
+  for (;;) {
+    if (waitpid(pid, &status, 0) >= 0) break;
+    if (errno != EINTR) {
+      wait_err = 1;
+      break;
+    }
+  }
+  g_child_pgid = 0;
+  sigaction(SIGINT, &old_int, nullptr);
+  sigaction(SIGTERM, &old_term, nullptr);
+  if (wait_err) return TK_ERR_INTERNAL;
+  if (timed_out) return TK_ERR_TIMEOUT;
+  if (WIFEXITED(status)) {
+    const int code = WEXITSTATUS(status);
+    // 127 after our own exec error message means spawn failure
+    if (code == 127 && tail != nullptr &&
+        strstr(tail, "tk_runtime: exec ") != nullptr)
+      return TK_ERR_SPAWN;
+    return code;
+  }
+  if (WIFSIGNALED(status)) return TK_ERR_SIGNAL;
+  return TK_ERR_INTERNAL;
+}
+
+// Acquire an exclusive advisory flock on path, creating it if needed.
+// Retries until timeout_ms (0 = single non-blocking attempt; < 0 = wait
+// forever). Returns the held fd (>= 0) or -1 on timeout/error. The lock
+// dies with the fd — including on process crash, which is exactly the
+// property the JSON-lockfile scheme cannot provide by itself.
+int tk_lock_acquire(const char *path, int timeout_ms) {
+  const int fd = open(path, O_CREAT | O_RDWR | O_CLOEXEC, 0644);
+  if (fd < 0) return -1;
+  const double deadline =
+      timeout_ms >= 0 ? monotonic_now() + timeout_ms / 1000.0 : 0.0;
+  for (;;) {
+    if (flock(fd, LOCK_EX | LOCK_NB) == 0) return fd;
+    if (errno != EWOULDBLOCK && errno != EINTR) break;
+    if (timeout_ms >= 0 && monotonic_now() >= deadline) break;
+    usleep(20 * 1000);
+  }
+  close(fd);
+  return -1;
+}
+
+int tk_lock_release(int fd) {
+  if (fd < 0) return -1;
+  flock(fd, LOCK_UN);
+  return close(fd);
+}
+
+// Library self-identification for the ctypes loader's version check.
+int tk_abi_version() { return 1; }
+
+}  // extern "C"

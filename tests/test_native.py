@@ -1,0 +1,166 @@
+"""Native C++ runtime tests: build/load, streaming runner semantics (exit
+codes, tail capture, timeout kill, spawn failure), flock contention, and
+the executor + local-backend integrations (with forced pure-Python
+fallback parity)."""
+
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_kubernetes import native
+from tpu_kubernetes.backend.local import LocalBackend
+from tpu_kubernetes.shell.executor import ExecutorError, TerraformExecutor
+from tpu_kubernetes.state import State
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native runtime not built (no g++?)"
+)
+
+
+class TestRunStreaming:
+    def test_exit_code_and_tail(self):
+        code, tail = native.run_streaming(
+            ["sh", "-c", "echo out; echo err >&2; exit 3"], stream=False
+        )
+        assert code == 3
+        assert "out" in tail and "err" in tail
+
+    def test_success(self):
+        code, tail = native.run_streaming(["true"], stream=False)
+        assert code == 0
+
+    def test_timeout_kills_process_group(self):
+        t0 = time.monotonic()
+        code, _ = native.run_streaming(
+            ["sh", "-c", "sleep 30 & sleep 30"], timeout_s=0.5, stream=False
+        )
+        assert code == native.TIMEOUT
+        assert time.monotonic() - t0 < 5
+
+    def test_spawn_failure(self):
+        code, tail = native.run_streaming(
+            ["definitely-not-a-binary-xyz"], stream=False
+        )
+        assert code == native.SPAWN_FAILURE
+        assert "exec" in tail
+
+    def test_tail_keeps_last_bytes(self):
+        code, tail = native.run_streaming(
+            ["sh", "-c", "seq 1 5000"], stream=False, tail_bytes=256
+        )
+        assert code == 0
+        assert "5000" in tail and "1\n2\n" not in tail
+
+    def test_cwd(self, tmp_path):
+        code, tail = native.run_streaming(
+            ["pwd"], cwd=tmp_path, stream=False
+        )
+        assert code == 0
+        assert tail.strip().endswith(tmp_path.name)
+
+    def test_sigint_forwarded_to_child(self):
+        """Ctrl-C during a native run must kill the child (which lives in
+        its own process group) rather than leave the parent wedged."""
+        import signal
+
+        prog = (
+            "from tpu_kubernetes import native; import sys;"
+            "sys.stdout.write('go'); sys.stdout.flush();"
+            "code, _ = native.run_streaming(['sleep', '30'], stream=False);"
+            "sys.stdout.write(f'code={code}'); sys.stdout.flush()"
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-c", prog], stdout=subprocess.PIPE, text=True,
+            cwd=Path(__file__).resolve().parents[1],
+        )
+        assert proc.stdout.read(2) == "go"
+        time.sleep(0.5)  # let it enter the native call
+        proc.send_signal(signal.SIGINT)
+        t0 = time.monotonic()
+        out, _ = proc.communicate(timeout=10)
+        assert time.monotonic() - t0 < 8
+        assert f"code={native.SIGNALED}" in out
+
+
+class TestFileLock:
+    def test_contention_and_release(self, tmp_path):
+        p = tmp_path / "x.flock"
+        with native.FileLock(p):
+            assert native.FileLock(p, timeout_s=0.2).acquire() is False
+        l2 = native.FileLock(p, timeout_s=0.2)
+        assert l2.acquire() is True
+        l2.release()
+
+    def test_released_on_process_death(self, tmp_path):
+        """A crashed holder's flock must evaporate with its fd."""
+        p = tmp_path / "crash.flock"
+        prog = (
+            "from tpu_kubernetes import native; import os, sys;"
+            f"l = native.FileLock({str(p)!r});"
+            "assert l.acquire(); sys.stdout.write('held'); sys.stdout.flush();"
+            "os._exit(1)"  # die without releasing
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", prog], capture_output=True, text=True,
+            cwd=Path(__file__).resolve().parents[1],
+        )
+        assert "held" in proc.stdout
+        assert native.FileLock(p, timeout_s=0.5).acquire() is True
+
+
+class TestExecutorIntegration:
+    def _executor(self, **kw):
+        return TerraformExecutor(terraform_bin="sh", stream_output=False, **kw)
+
+    def test_error_includes_output_tail(self, tmp_path):
+        ex = TerraformExecutor(
+            terraform_bin="definitely-not-terraform", stream_output=False
+        )
+        with pytest.raises(ExecutorError, match="not found on PATH"):
+            ex._run(["init"], tmp_path)
+
+    def test_timeout_maps_to_executor_error(self, tmp_path):
+        ex = TerraformExecutor(
+            terraform_bin="sleep", stream_output=False, timeout_s=0.5
+        )
+        with pytest.raises(ExecutorError, match="timeout"):
+            ex._run(["30"], tmp_path)
+
+    def test_python_fallback_parity(self, tmp_path, monkeypatch):
+        """TPU_K8S_NATIVE=0 must give the same error surface."""
+        monkeypatch.setattr(native, "_lib", False)
+        try:
+            ex = TerraformExecutor(
+                terraform_bin="definitely-not-terraform", stream_output=False
+            )
+            with pytest.raises(ExecutorError, match="not found on PATH"):
+                ex._run(["init"], tmp_path)
+            ex2 = TerraformExecutor(
+                terraform_bin="sleep", stream_output=False, timeout_s=0.5
+            )
+            with pytest.raises(ExecutorError, match="timeout"):
+                ex2._run(["30"], tmp_path)
+        finally:
+            monkeypatch.setattr(native, "_lib", None)
+
+
+class TestBackendLockIntegration:
+    def test_lock_roundtrip_with_flock(self, tmp_path):
+        b = LocalBackend(root=tmp_path)
+        with b.lock("m"):
+            assert (tmp_path / "m" / ".lock").is_file()
+        assert not (tmp_path / "m" / ".lock").is_file()
+
+    def test_contender_rejected(self, tmp_path):
+        from tpu_kubernetes.backend.base import LockError
+
+        b1 = LocalBackend(root=tmp_path)
+        b2 = LocalBackend(root=tmp_path)
+        with b1.lock("m"):
+            with pytest.raises(LockError, match="is locked by"):
+                with b2.lock("m"):
+                    pass

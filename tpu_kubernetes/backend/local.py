@@ -73,25 +73,45 @@ class LocalBackend(Backend):
         tmp.write_bytes(state.to_bytes())
         tmp.replace(d / STATE_FILE)
 
+    @contextlib.contextmanager
+    def _flock(self, name: str):
+        """Native flock(2) serializing this state's lockfile critical
+        sections between same-host processes (no-op context when the
+        native runtime isn't built); TimeoutError → LockError so callers
+        see the documented Backend error surface."""
+        from tpu_kubernetes.native import FileLock
+
+        try:
+            with FileLock(self._dir(name) / (LOCK_FILE + ".flock")):
+                yield
+        except TimeoutError as e:
+            raise LockError(
+                f"could not serialize lockfile access for state {name!r}: {e}"
+            ) from e
+
     def _refresh_held_lock(self, name: str) -> None:
         """If this instance holds ``name``'s lock, verify it wasn't stale-
         broken by a contender (fail loudly rather than clobber their work)
-        and reset its TTL clock."""
+        and reset its TTL clock. The read-check-write is serialized under
+        the same flock as acquisition — otherwise a refresh racing a
+        contender's stale-break could clobber the contender's fresh lock
+        and leave two processes believing they hold the state."""
         owner = self._held.get(name)
         if owner is None:
             return
         path = self._dir(name) / LOCK_FILE
-        try:
-            current = json.loads(path.read_bytes())
-        except (ValueError, OSError):
-            current = {}
-        if current.get("owner") != owner:
-            raise LockError(
-                f"lock on state {name!r} was lost mid-workflow "
-                "(broken as stale by another process?) — NOT persisting"
-            )
-        current["acquired_at"] = time.time()
-        path.write_bytes(json.dumps(current).encode())
+        with self._flock(name):
+            try:
+                current = json.loads(path.read_bytes())
+            except (ValueError, OSError):
+                current = {}
+            if current.get("owner") != owner:
+                raise LockError(
+                    f"lock on state {name!r} was lost mid-workflow "
+                    "(broken as stale by another process?) — NOT persisting"
+                )
+            current["acquired_at"] = time.time()
+            path.write_bytes(json.dumps(current).encode())
 
     def delete_state(self, name: str) -> None:
         d = self._dir(name)
@@ -107,7 +127,14 @@ class LocalBackend(Backend):
         """Lockfile with O_EXCL creation; stale locks (older than
         ``lock_ttl_s``, e.g. a crashed apply) are broken. Release only deletes
         a lock this context still owns, so a slow holder cannot delete its
-        successor's lock."""
+        successor's lock.
+
+        The acquire/stale-break and release read-check-delete critical
+        sections additionally serialize on a native flock(2) when the C++
+        runtime is built (tpu_kubernetes/native): two same-host contenders
+        can otherwise both judge a lock stale and both break it. The flock
+        guards only these short sections, not the whole workflow — the JSON
+        file carries the cross-host ownership semantics."""
         path = self._dir(name) / LOCK_FILE
         path.parent.mkdir(parents=True, exist_ok=True)
         owner = uuid.uuid4().hex
@@ -119,26 +146,29 @@ class LocalBackend(Backend):
                 "acquired_at": time.time(),
             }
         ).encode()
+
         # write-then-link so the lockfile is never visible without its payload
         # (a contender reading a half-written lock must see it as HELD, not
         # stale, or two holders could both enter)
         tmp = path.with_name(f"{LOCK_FILE}.{owner}")
         tmp.write_bytes(payload)
         try:
-            os.link(tmp, path)  # atomic create; FileExistsError if held
-        except FileExistsError:
-            info: dict = {}
-            try:
-                info = json.loads(path.read_bytes())
-            except (ValueError, OSError):
-                info = {"acquired_at": time.time()}  # unreadable ⇒ assume held
-            if time.time() - info.get("acquired_at", time.time()) > self.lock_ttl_s:
-                path.write_bytes(payload)  # stale: break it (best-effort)
-            else:
-                raise LockError(
-                    f"state {name!r} is locked by pid {info.get('pid', '?')} on "
-                    f"{info.get('host', '?')} (delete {path} to force)"
-                ) from None
+            with self._flock(name):
+                try:
+                    os.link(tmp, path)  # atomic create; FileExistsError if held
+                except FileExistsError:
+                    info: dict = {}
+                    try:
+                        info = json.loads(path.read_bytes())
+                    except (ValueError, OSError):
+                        info = {"acquired_at": time.time()}  # unreadable ⇒ assume held
+                    if time.time() - info.get("acquired_at", time.time()) > self.lock_ttl_s:
+                        path.write_bytes(payload)  # stale: break it
+                    else:
+                        raise LockError(
+                            f"state {name!r} is locked by pid {info.get('pid', '?')} on "
+                            f"{info.get('host', '?')} (delete {path} to force)"
+                        ) from None
         finally:
             tmp.unlink(missing_ok=True)
         self._held[name] = owner
@@ -147,9 +177,10 @@ class LocalBackend(Backend):
         finally:
             self._held.pop(name, None)
             try:
-                if json.loads(path.read_bytes()).get("owner") == owner:
-                    path.unlink()
-            except (ValueError, OSError):
+                with self._flock(name):
+                    if json.loads(path.read_bytes()).get("owner") == owner:
+                        path.unlink()
+            except (ValueError, OSError, LockError):
                 pass
 
     def __repr__(self) -> str:

@@ -144,14 +144,14 @@ def _route(gates: jax.Array, k: int, capacity: int):
 
     Two passes, k a small static int. Pass 1 picks the k choice masks
     (argmax, mask out, repeat) — these depend only on each token's own
-    gates. Pass 2 assigns capacity slots with a single exclusive cumsum
-    over the sequence, ordering claims lexicographically by (position,
-    round), so slot assignment — and therefore overflow dropping — is
-    **causal**: whether a token is kept depends only on tokens before it,
-    never on later ones (plain GShard offsets round-2 slots by whole-batch
-    round-1 counts and silently leaks future positions into the drop
-    pattern). Dropped tokens pass through on the residual; combine weights
-    are renormalized over the *selected* experts (Mixtral semantics)."""
+    gates, and a token never claims the same expert twice. Pass 2 assigns
+    capacity slots with a single exclusive cumsum over the sequence, so
+    slot assignment — and therefore overflow dropping — is **causal**:
+    whether a token is kept depends only on tokens before it, never on
+    later ones (plain GShard offsets round-2 slots by whole-batch round-1
+    counts and silently leaks future positions into the drop pattern).
+    Dropped tokens pass through on the residual; combine weights are
+    renormalized over the *selected* experts (Mixtral semantics)."""
     b, s, E = gates.shape
     remaining = gates
     masks = []
@@ -162,16 +162,15 @@ def _route(gates: jax.Array, k: int, capacity: int):
         remaining = remaining * (1.0 - mask)
     first_mask = masks[0]
 
-    # claims on each expert by strictly earlier tokens (any round)
+    # a token's slot in expert e = number of claims on e by strictly
+    # earlier tokens (any round; rounds of one token hit distinct experts)
     total = sum(masks)
-    earlier = jnp.cumsum(total, axis=1) - total           # exclusive cumsum
+    pos = jnp.cumsum(total, axis=1) - total               # exclusive cumsum
 
     dispatch = jnp.zeros((b, s, E, capacity), jnp.float32)
     combine = jnp.zeros((b, s, E, capacity), jnp.float32)
     selected_sum = jnp.zeros((b, s), jnp.float32)
-    same_token = jnp.zeros((b, s, E), jnp.float32)        # earlier rounds, same token
     for mask in masks:
-        pos = earlier + same_token
         keep = mask * (pos < capacity)
         slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
         sel = keep[..., None] * slot                      # (b, s, E, C)
@@ -179,7 +178,6 @@ def _route(gates: jax.Array, k: int, capacity: int):
         dispatch = dispatch + sel
         combine = combine + sel * gate_i[..., None, None]
         selected_sum = selected_sum + gate_i
-        same_token = same_token + mask
 
     combine = combine / jnp.maximum(selected_sum, 1e-9)[..., None, None]
     return dispatch, combine, first_mask

@@ -32,10 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-try:  # jax ≥ 0.8 top-level export; fall back for older
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from jax import shard_map  # requires jax ≥ 0.8 (pcast below does too)
 
 from tpu_kubernetes.models import ModelConfig
 from tpu_kubernetes.models.llama import _block

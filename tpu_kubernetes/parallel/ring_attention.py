@@ -21,10 +21,7 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
-try:  # jax ≥ 0.8 top-level export; fall back for older
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from jax import shard_map  # requires jax ≥ 0.8 (pcast below does too)
 
 NEG_INF = -1e30
 

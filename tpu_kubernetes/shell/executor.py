@@ -68,25 +68,63 @@ class TerraformExecutor(Executor):
         terraform_bin: str = "terraform",
         tracer: Tracer | None = None,
         stream_output: bool = True,
+        timeout_s: float = 0.0,
     ):
         self.terraform_bin = terraform_bin
         self.tracer = tracer or TRACER
         self.stream_output = stream_output
+        # 0 = no deadline; set to bound a wedged terraform apply
+        self.timeout_s = timeout_s
 
     def _run(self, args: Sequence[str], cwd: Path) -> None:
-        """Stream a subprocess through. reference: shell/run_shell_cmd.go:8-13."""
+        """Stream a subprocess through (reference: shell/run_shell_cmd.go:8-13)
+        via the native C++ runner when built (tpu_kubernetes/native — adds
+        deadline enforcement and an output tail in errors), else plain
+        subprocess."""
         cmd = [self.terraform_bin, *args]
+        from tpu_kubernetes import native
+
+        if native.available():
+            code, tail = native.run_streaming(
+                cmd, cwd=cwd, timeout_s=self.timeout_s,
+                stream=self.stream_output,
+            )
+            if code == native.SPAWN_FAILURE:
+                raise ExecutorError(
+                    f"terraform binary {self.terraform_bin!r} not found on PATH "
+                    "(install terraform, or use the fake executor for dry runs)"
+                )
+            if code == native.TIMEOUT:
+                raise ExecutorError(
+                    f"{' '.join(cmd)} killed after {self.timeout_s}s timeout"
+                )
+            if code == native.SIGNALED:
+                raise ExecutorError(
+                    f"{' '.join(cmd)} terminated by signal (interrupted?)"
+                )
+            if code != 0:
+                detail = "" if self.stream_output else f"\n{tail}"
+                raise ExecutorError(
+                    f"{' '.join(cmd)} exited with status {code}{detail}"
+                )
+            return
+
         try:
             proc = subprocess.run(
                 cmd,
                 cwd=cwd,
                 stdout=None if self.stream_output else subprocess.PIPE,
                 stderr=None if self.stream_output else subprocess.STDOUT,
+                timeout=self.timeout_s or None,
             )
         except FileNotFoundError as e:
             raise ExecutorError(
                 f"terraform binary {self.terraform_bin!r} not found on PATH "
                 "(install terraform, or use the fake executor for dry runs)"
+            ) from e
+        except subprocess.TimeoutExpired as e:
+            raise ExecutorError(
+                f"{' '.join(cmd)} killed after {self.timeout_s}s timeout"
             ) from e
         if proc.returncode != 0:
             detail = "" if self.stream_output else f"\n{proc.stdout.decode(errors='replace')}"
