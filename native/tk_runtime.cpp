@@ -130,17 +130,26 @@ int tk_run_streaming(const char *const argv[], const char *cwd,
   const double deadline =
       timeout_s > 0 ? monotonic_now() + timeout_s : 0.0;
   bool timed_out = false;
+  bool child_done = false;  // reaped via WNOHANG mid-loop
+  int status = 0;
   char buf[8192];
 
+  // Read until EOF, deadline, or (child exited AND the pipe went quiet).
+  // The quiet condition matters: a daemonizing grandchild that inherited
+  // the merged stdout/stderr fd would otherwise hold the pipe open
+  // forever after the direct child exits, wedging the caller — the
+  // Python subprocess fallback returns when the child exits, so we must
+  // too.
   for (;;) {
-    int poll_ms = -1;
+    int poll_ms = 200;  // bounded so child exit is noticed promptly
     if (deadline > 0) {
       const double left = deadline - monotonic_now();
       if (left <= 0) {
         timed_out = true;
         break;
       }
-      poll_ms = static_cast<int>(left * 1000.0) + 1;
+      const int left_ms = static_cast<int>(left * 1000.0) + 1;
+      if (left_ms < poll_ms) poll_ms = left_ms;
     }
     struct pollfd pfd = {pipefd[0], POLLIN, 0};
     const int pr = poll(&pfd, 1, poll_ms);
@@ -148,16 +157,18 @@ int tk_run_streaming(const char *const argv[], const char *cwd,
       if (errno == EINTR) continue;
       break;
     }
-    if (pr == 0) {  // poll timeout — deadline passed
-      timed_out = true;
-      break;
+    if (pr == 0) {  // poll tick: no data
+      if (child_done) break;  // child gone and pipe quiet — stop waiting
+      if (!child_done && waitpid(pid, &status, WNOHANG) == pid)
+        child_done = true;  // drain whatever remains on subsequent ticks
+      continue;
     }
     const ssize_t n = read(pipefd[0], buf, sizeof buf);
     if (n < 0) {
       if (errno == EINTR) continue;
       break;
     }
-    if (n == 0) break;  // EOF — child closed its end
+    if (n == 0) break;  // EOF — all writers closed their ends
     if (stream) {
       ssize_t off = 0;
       while (off < n) {
@@ -175,9 +186,8 @@ int tk_run_streaming(const char *const argv[], const char *cwd,
     kill(pid, SIGKILL);
   }
 
-  int status = 0;
   int wait_err = 0;
-  for (;;) {
+  for (; !child_done;) {
     if (waitpid(pid, &status, 0) >= 0) break;
     if (errno != EINTR) {
       wait_err = 1;

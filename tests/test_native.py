@@ -62,6 +62,17 @@ class TestRunStreaming:
         assert code == 0
         assert tail.strip().endswith(tmp_path.name)
 
+    def test_returns_when_child_exits_despite_daemon_grandchild(self):
+        """A daemonizing grandchild inheriting the pipe must not wedge the
+        runner past the direct child's exit."""
+        t0 = time.monotonic()
+        code, tail = native.run_streaming(
+            ["sh", "-c", "echo started; sleep 30 & exit 0"], stream=False
+        )
+        assert code == 0
+        assert "started" in tail
+        assert time.monotonic() - t0 < 5
+
     def test_sigint_forwarded_to_child(self):
         """Ctrl-C during a native run must kill the child (which lives in
         its own process group) rather than leave the parent wedged."""

@@ -69,6 +69,26 @@ def test_flash_gradients_match_reference(causal):
         )
 
 
+def test_flash_fits_oversized_blocks_to_seq():
+    """seq lengths divisible by a halving of the block (768 with block 512
+    → 256) must work — raising the default block size can't break
+    sequence lengths the old 128 default accepted."""
+    from tpu_kubernetes.ops.flash_attention import _fit_block
+
+    assert _fit_block(512, 768) == 256
+    assert _fit_block(512, 640) == 128
+    assert _fit_block(512, 2048) == 512
+    assert _fit_block(512, 8) == 8
+    q, k, v = qkv(seq=192)
+    out = flash_attention(
+        q, k, v, causal=True, block_q=512, block_k=512, interpret=True
+    )
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3
+    )
+
+
 def test_flash_rejects_indivisible_seq():
     q, k, v = qkv()
     with pytest.raises(ValueError, match="divisible"):

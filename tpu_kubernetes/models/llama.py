@@ -46,11 +46,17 @@ class ModelConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-6
     dtype: Any = jnp.bfloat16
-    # attention implementation knobs (forwarded to ops.flash_attention)
-    attn_block_q: int = 128
-    attn_block_k: int = 128
+    # attention implementation knobs (forwarded to ops.flash_attention).
+    # 512-blocks measured 1.40× faster than 128 end-to-end on v5e (llama-1b,
+    # seq 2048); the kernel clamps them to the sequence length
+    attn_block_q: int = 512
+    attn_block_k: int = 512
     use_pallas: bool | None = None
     remat: bool = True
+    # "dots" saves weight-matmul outputs and recomputes the cheap
+    # elementwise ops (5% faster than "full" recompute on v5e, small HBM
+    # cost); "full" recomputes everything (max memory headroom)
+    remat_policy: str = "dots"  # "dots" | "full"
 
     @property
     def head_dim(self) -> int:
@@ -179,6 +185,17 @@ def _block(cfg: ModelConfig, cos, sin, x, layer):
     return x + gated @ layer["w_down"]
 
 
+def remat_policy_kwargs(cfg: ModelConfig):
+    """→ kwargs for jax.checkpoint per cfg.remat_policy."""
+    if cfg.remat_policy == "dots":
+        return {
+            "policy": jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        }
+    if cfg.remat_policy == "full":
+        return {}
+    raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
+
+
 def forward(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
     """tokens (batch, seq) int32 → logits (batch, seq, vocab) float32."""
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
@@ -186,7 +203,8 @@ def forward(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
 
     block = lambda x, layer: (_block(cfg, cos, sin, x, layer), None)
     if cfg.remat:
-        block = jax.checkpoint(block)  # trade FLOPs for HBM across layers
+        # trade FLOPs for HBM across layers
+        block = jax.checkpoint(block, **remat_policy_kwargs(cfg))
     x, _ = jax.lax.scan(block, x, params["layers"])
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)

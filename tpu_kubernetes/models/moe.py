@@ -38,6 +38,7 @@ from tpu_kubernetes.models.llama import (
     ModelConfig,
     _dense_init,
     attention_sublayer,
+    remat_policy_kwargs,
 )
 from tpu_kubernetes.ops import next_token_nll, rms_norm, rope_frequencies
 
@@ -159,7 +160,10 @@ def _route(gates: jax.Array, k: int, capacity: int):
         idx = jnp.argmax(remaining, axis=-1)              # (b, s)
         mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # (b, s, E)
         masks.append(mask)
-        remaining = remaining * (1.0 - mask)
+        # chosen experts drop to -1 (not 0): even if every unchosen gate
+        # underflowed to exactly 0.0, argmax can never re-pick an expert,
+        # preserving the distinct-experts invariant pass 2 relies on
+        remaining = jnp.where(mask > 0, -1.0, remaining)
     first_mask = masks[0]
 
     # a token's slot in expert e = number of claims on e by strictly
@@ -232,7 +236,7 @@ def forward_with_aux(
         return x, aux
 
     if cfg.remat:
-        block = jax.checkpoint(block)
+        block = jax.checkpoint(block, **remat_policy_kwargs(cfg))
     x, aux = jax.lax.scan(block, x, params["layers"])
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)

@@ -359,6 +359,18 @@ def _on_tpu() -> bool:
         return False
 
 
+def _fit_block(block: int, seq: int) -> int:
+    """Largest halving of ``block`` that divides ``seq`` (seq=768 with
+    block=512 → 256), so raising the default block size never breaks
+    sequence lengths the smaller default accepted. Degenerate fits
+    (< 16 — pathological for the MXU) fall through to the caller's
+    divisibility error instead."""
+    block = min(block, seq)
+    while block >= 16 and seq % block:
+        block //= 2
+    return block
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -384,8 +396,8 @@ def flash_attention(
         return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
 
     seq_q, seq_k = q.shape[2], k.shape[2]
-    block_q = min(block_q, seq_q)
-    block_k = min(block_k, seq_k)
+    block_q = _fit_block(block_q, seq_q)
+    block_k = _fit_block(block_k, seq_k)
     if seq_q % block_q or seq_k % block_k:
         raise ValueError(
             f"sequence lengths ({seq_q}, {seq_k}) must be divisible by the "
