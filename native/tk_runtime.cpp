@@ -134,21 +134,29 @@ int tk_run_streaming(const char *const argv[], const char *cwd,
   int status = 0;
   char buf[8192];
 
-  // Read until EOF, deadline, or (child exited AND the pipe went quiet).
-  // The quiet condition matters: a daemonizing grandchild that inherited
-  // the merged stdout/stderr fd would otherwise hold the pipe open
-  // forever after the direct child exits, wedging the caller — the
+  // Read until EOF, deadline, or the direct child exits (with a short
+  // bounded drain). The drain bound matters: a daemonizing grandchild
+  // that inherited the merged stdout/stderr fd can hold the pipe open —
+  // and keep chattering on it — forever after the child exits; the
   // Python subprocess fallback returns when the child exits, so we must
-  // too.
+  // too, no matter what the grandchild does.
+  double drain_deadline = 0.0;  // set once the child is reaped
   for (;;) {
-    int poll_ms = 200;  // bounded so child exit is noticed promptly
-    if (deadline > 0) {
-      const double left = deadline - monotonic_now();
-      if (left <= 0) {
-        timed_out = true;
-        break;
-      }
-      const int left_ms = static_cast<int>(left * 1000.0) + 1;
+    const double now = monotonic_now();
+    if (!child_done && waitpid(pid, &status, WNOHANG) == pid) {
+      child_done = true;
+      drain_deadline = now + 0.2;  // grab already-buffered output, then go
+    }
+    if (child_done && now >= drain_deadline) break;
+    if (!child_done && deadline > 0 && now >= deadline) {
+      timed_out = true;
+      break;
+    }
+    int poll_ms = 100;  // bounded so child exit is noticed promptly
+    if (child_done)
+      poll_ms = static_cast<int>((drain_deadline - now) * 1000.0) + 1;
+    else if (deadline > 0) {
+      const int left_ms = static_cast<int>((deadline - now) * 1000.0) + 1;
       if (left_ms < poll_ms) poll_ms = left_ms;
     }
     struct pollfd pfd = {pipefd[0], POLLIN, 0};
@@ -157,12 +165,7 @@ int tk_run_streaming(const char *const argv[], const char *cwd,
       if (errno == EINTR) continue;
       break;
     }
-    if (pr == 0) {  // poll tick: no data
-      if (child_done) break;  // child gone and pipe quiet — stop waiting
-      if (!child_done && waitpid(pid, &status, WNOHANG) == pid)
-        child_done = true;  // drain whatever remains on subsequent ticks
-      continue;
-    }
+    if (pr == 0) continue;  // tick: re-check child/deadline/drain above
     const ssize_t n = read(pipefd[0], buf, sizeof buf);
     if (n < 0) {
       if (errno == EINTR) continue;
@@ -183,7 +186,7 @@ int tk_run_streaming(const char *const argv[], const char *cwd,
 
   if (timed_out) {
     kill(-pid, SIGKILL);  // the whole process group
-    kill(pid, SIGKILL);
+    if (!child_done) kill(pid, SIGKILL);  // pid is reaped once child_done
   }
 
   int wait_err = 0;

@@ -73,6 +73,19 @@ class TestRunStreaming:
         assert "started" in tail
         assert time.monotonic() - t0 < 5
 
+    def test_chattering_grandchild_cannot_wedge_or_fake_timeout(self):
+        """A grandchild writing faster than the poll tick must neither
+        wedge the runner nor turn the child's clean exit into a timeout."""
+        t0 = time.monotonic()
+        code, tail = native.run_streaming(
+            ["sh", "-c",
+             "( while true; do echo x; sleep 0.05; done ) & echo started; exit 0"],
+            timeout_s=5, stream=False,
+        )
+        assert code == 0, f"expected clean exit, got {code}"
+        assert "started" in tail
+        assert time.monotonic() - t0 < 3  # returned on child exit + drain
+
     def test_sigint_forwarded_to_child(self):
         """Ctrl-C during a native run must kill the child (which lives in
         its own process group) rather than leave the parent wedged."""

@@ -7,10 +7,13 @@ from tpu_kubernetes.parallel.distributed import (  # noqa: F401
     read_env,
 )
 from tpu_kubernetes.parallel.mesh import (  # noqa: F401
+    DATA_AXES,
     DEFAULT_RULES,
     MESH_AXES,
     batch_sharding,
+    create_hybrid_mesh,
     create_mesh,
+    data_axes_in,
     logical_to_spec,
     mesh_shape_for_devices,
     param_shardings,

@@ -27,10 +27,19 @@ class DistributedEnv:
     process_id: int
     accelerator_type: str | None
     slice_topology: str | None
+    # multislice (DCN-connected pod slices): the provisioner emits these
+    # when a cluster spans several slices (MEGASCALE_* is the libtpu
+    # convention; the node module mirrors it into jax.env)
+    num_slices: int = 1
+    slice_id: int = 0
 
     @property
     def multi_host(self) -> bool:
         return self.num_processes > 1
+
+    @property
+    def multi_slice(self) -> bool:
+        return self.num_slices > 1
 
 
 def read_env(env: dict[str, str] | None = None) -> DistributedEnv:
@@ -41,6 +50,8 @@ def read_env(env: dict[str, str] | None = None) -> DistributedEnv:
         process_id=int(e.get("JAX_PROCESS_ID", "0")),
         accelerator_type=e.get("TPU_ACCELERATOR_TYPE"),
         slice_topology=e.get("TPU_SLICE_TOPOLOGY"),
+        num_slices=int(e.get("MEGASCALE_NUM_SLICES", "1")),
+        slice_id=int(e.get("MEGASCALE_SLICE_ID", "0")),
     )
 
 

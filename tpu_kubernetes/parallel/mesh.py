@@ -117,6 +117,77 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(data_axes))
 
 
+def create_hybrid_mesh(
+    ici_shape: Mapping[str, int],
+    dcn_shape: Mapping[str, int],
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Mesh spanning multiple TPU slices: ``dcn_shape`` axes cross slice
+    boundaries (data-center network — orders of magnitude less bandwidth
+    than ICI), ``ici_shape`` axes stay within a slice. The scaling-book
+    recipe: put data/pipeline parallelism on DCN axes and
+    fsdp/tensor/sequence on ICI axes, so per-step collectives ride ICI and
+    only gradient reductions cross slices.
+
+    Devices are grouped into slices by their ``slice_index`` attribute
+    (real multislice TPU) or evenly by order (CPU test meshes). DCN axes
+    vary slowest; an axis may not appear in both shapes."""
+    ici_shape = {k: v for k, v in ici_shape.items() if v != 0}
+    dcn_shape = {k: v for k, v in dcn_shape.items() if v != 0}
+    overlap = set(ici_shape) & set(dcn_shape)
+    if overlap:
+        raise ValueError(
+            f"axes {sorted(overlap)} appear in both ici and dcn shapes — "
+            "an axis is either intra-slice (ICI) or cross-slice (DCN)"
+        )
+    unknown = (set(ici_shape) | set(dcn_shape)) - set(MESH_AXES)
+    if unknown:
+        raise ValueError(f"unknown mesh axes {sorted(unknown)}; known {MESH_AXES}")
+
+    if devices is None:
+        devices = jax.devices()
+    n_slices = int(np.prod(list(dcn_shape.values()) or [1]))
+    per_slice = int(np.prod(list(ici_shape.values()) or [1]))
+    if n_slices * per_slice != len(devices):
+        raise ValueError(
+            f"hybrid mesh dcn={dict(dcn_shape)} × ici={dict(ici_shape)} wants "
+            f"{n_slices}×{per_slice} devices, have {len(devices)}"
+        )
+
+    # group by slice_index when the runtime provides it, else evenly
+    if all(getattr(d, "slice_index", None) is not None for d in devices):
+        by_slice: dict[int, list] = {}
+        for d in devices:
+            by_slice.setdefault(d.slice_index, []).append(d)
+        if len(by_slice) != n_slices or any(
+            len(g) != per_slice for g in by_slice.values()
+        ):
+            raise ValueError(
+                f"found {len(by_slice)} hardware slices of sizes "
+                f"{[len(g) for g in by_slice.values()]}; dcn×ici shape wants "
+                f"{n_slices} slices of {per_slice}"
+            )
+        groups = [by_slice[k] for k in sorted(by_slice)]
+    else:
+        groups = [
+            list(devices[i * per_slice:(i + 1) * per_slice])
+            for i in range(n_slices)
+        ]
+
+    dcn_axes = tuple(a for a in MESH_AXES if a in dcn_shape)
+    ici_axes = tuple(a for a in MESH_AXES if a in ici_shape)
+    ici_sizes = tuple(ici_shape[a] for a in ici_axes)
+    # good ICI ordering within each slice, then stack over the DCN axes
+    slice_meshes = [
+        mesh_utils.create_device_mesh(ici_sizes, devices=g) if ici_sizes
+        else np.array(g)
+        for g in groups
+    ]
+    dcn_sizes = tuple(dcn_shape[a] for a in dcn_axes)
+    device_array = np.stack(slice_meshes).reshape(*dcn_sizes, *ici_sizes)
+    return Mesh(device_array, (*dcn_axes, *ici_axes))
+
+
 def mesh_shape_for_devices(n: int) -> dict[str, int]:
     """A sensible default mesh for n devices: tensor innermost (2 if even),
     rest fsdp, data=1 (fsdp already data-parallels the batch)."""

@@ -1,6 +1,13 @@
-"""tpu_kubernetes.train — training loop, optimizer, and checkpointing for
-the in-tree example job."""
+"""tpu_kubernetes.train — training loop, optimizer, input pipeline, and
+checkpointing for the in-tree example job."""
 
+from tpu_kubernetes.train.data import (  # noqa: F401
+    TokenDataset,
+    global_batches,
+    input_pipeline,
+    local_batches,
+    prefetch,
+)
 from tpu_kubernetes.train.trainer import (  # noqa: F401
     TrainConfig,
     init_state,

@@ -1,0 +1,180 @@
+"""Input pipeline: memory-mapped token shards → per-host loading → global
+device arrays, with background prefetch.
+
+The reference provisioner has no data plane of its own (its job ends when
+the cluster registers); this is part of the in-tree training stack. The
+TPU-first shape of an input pipeline:
+
+* **Each process reads only its stripe.** A multi-host slice runs one
+  process per host; tokens are striped across processes by sequence index
+  (process p takes sequences p, p+P, p+2P, …), so no host reads or
+  materializes the global batch.
+* **Global arrays from local shards** via
+  ``jax.make_array_from_process_local_data`` — the per-host arrays become
+  one logically-global batch laid out to match the train step's batch
+  sharding, no cross-host shuffle.
+* **Prefetch.** A background thread stages the next batches host→device
+  while the current step runs, hiding transfer latency behind compute
+  (the host↔device transfer is the classic input-bound stall).
+
+Token files are flat little-endian arrays (uint16 for vocab < 65536 else
+uint32), memory-mapped — the OS page cache does the buffering, nothing is
+ever fully loaded.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from pathlib import Path
+from typing import Any, Iterator
+
+import jax
+import numpy as np
+
+
+class DataError(Exception):
+    pass
+
+
+class TokenDataset:
+    """Flat token file → (seq+1)-length training sequences.
+
+    ``path`` may be a single file or a directory of ``*.bin`` shards
+    (concatenated in sorted order). dtype is inferred from ``vocab_size``.
+    Sequences are non-overlapping windows; the trailing remainder is
+    dropped (static shapes)."""
+
+    def __init__(self, path: str | Path, seq: int, vocab_size: int):
+        p = Path(path)
+        files = sorted(p.glob("*.bin")) if p.is_dir() else [p]
+        if not files or not all(f.is_file() for f in files):
+            raise DataError(f"no token shards at {path}")
+        dtype = np.uint16 if vocab_size <= 0xFFFF else np.uint32
+        self._maps = [np.memmap(f, dtype=dtype, mode="r") for f in files]
+        self._sizes = [m.shape[0] for m in self._maps]
+        self.seq = seq
+        self.window = seq + 1  # next-token loss consumes seq+1 tokens
+        self.n_sequences = sum(s // self.window for s in self._sizes)
+        if self.n_sequences == 0:
+            raise DataError(
+                f"{path}: {sum(self._sizes)} tokens < one window of {self.window}"
+            )
+
+    def __len__(self) -> int:
+        return self.n_sequences
+
+    def sequence(self, index: int) -> np.ndarray:
+        """The index-th window as int32 (window,)."""
+        if index < 0 or index >= self.n_sequences:
+            raise IndexError(index)
+        for m, size in zip(self._maps, self._sizes):
+            n = size // self.window
+            if index < n:
+                start = index * self.window
+                return np.asarray(
+                    m[start:start + self.window], dtype=np.int32
+                )
+            index -= n
+        raise IndexError(index)  # unreachable
+
+
+def local_batches(
+    dataset: TokenDataset,
+    global_batch: int,
+    *,
+    process_index: int | None = None,
+    process_count: int | None = None,
+    seed: int = 0,
+    epochs: int | None = None,
+    start_step: int = 0,
+) -> Iterator[np.ndarray]:
+    """This host's stripe of each global batch: (global_batch / P, seq+1)
+    int32, striped by sequence index and reshuffled each epoch with a
+    seeded permutation (identical on every host — only the stripe
+    differs). ``start_step`` skips the first N global batches without
+    loading them (checkpoint resume: the permutation sequence is
+    deterministic in ``seed``, so step s yields the same batch in every
+    run)."""
+    p = process_index if process_index is not None else jax.process_index()
+    P = process_count if process_count is not None else jax.process_count()
+    if global_batch % P:
+        raise DataError(f"global batch {global_batch} not divisible by {P} hosts")
+    local = global_batch // P
+    if dataset.n_sequences < global_batch:
+        raise DataError(
+            f"dataset has {dataset.n_sequences} sequences < one global "
+            f"batch of {global_batch}"
+        )
+    steps_per_epoch = dataset.n_sequences // global_batch
+    rng = np.random.default_rng(seed)
+    # fast-forward whole epochs: burn their permutations, not their batches
+    epoch = start_step // steps_per_epoch
+    skip = start_step % steps_per_epoch
+    for _ in range(epoch):
+        rng.permutation(dataset.n_sequences)
+    while epochs is None or epoch < epochs:
+        order = rng.permutation(dataset.n_sequences)
+        for s in range(skip, steps_per_epoch):
+            base = s * global_batch
+            idx = order[base + p:base + global_batch:P]
+            yield np.stack([dataset.sequence(int(i)) for i in idx[:local]])
+        skip = 0
+        epoch += 1
+
+
+def global_batches(
+    local_iter: Iterator[np.ndarray], sharding: Any
+) -> Iterator[jax.Array]:
+    """Assemble per-host local batches into logically-global sharded
+    arrays matching the train step's batch sharding."""
+    for local in local_iter:
+        yield jax.make_array_from_process_local_data(sharding, local)
+
+
+def prefetch(
+    it: Iterator[Any], depth: int = 2
+) -> Iterator[Any]:
+    """Stage up to ``depth`` items ahead on a background thread so host
+    work (file reads, device transfer dispatch) overlaps the running
+    step. Exceptions re-raise at the consumption point."""
+    if depth < 1:
+        yield from it
+        return
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    _END = object()
+
+    def worker():
+        try:
+            for item in it:
+                q.put(item)
+            q.put(_END)
+        except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+            q.put(e)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item
+
+
+def input_pipeline(
+    path: str | Path, global_batch: int, seq: int, vocab_size: int,
+    sharding: Any, *, seed: int = 0, prefetch_depth: int = 2,
+    start_step: int = 0,
+) -> Iterator[jax.Array]:
+    """The full pipeline: memmap shards → per-host stripe → global sharded
+    arrays → prefetch. One call site for the training job; pass the
+    resumed step as ``start_step`` so training continues through the
+    dataset instead of replaying it from the top."""
+    ds = TokenDataset(path, seq, vocab_size)
+    it = global_batches(
+        local_batches(ds, global_batch, seed=seed, start_step=start_step),
+        sharding,
+    )
+    return prefetch(it, depth=prefetch_depth)

@@ -9,8 +9,10 @@ trains the configured Llama over ICI. Step timing is logged so the driver
 can measure create→first-train-step latency (the north-star metric).
 
 Env knobs: JOB_MODEL (default llama-7b), JOB_BATCH (global), JOB_SEQ,
-JOB_STEPS, JOB_MESH ("data=1,fsdp=16,tensor=1"), JOB_CHECKPOINT_DIR,
-JOB_CHECKPOINT_EVERY.
+JOB_STEPS, JOB_MESH ("data=1,fsdp=16,tensor=1"), JOB_DCN_MESH (multislice:
+cross-slice axes, e.g. "data=2" — JOB_MESH then describes the intra-slice
+ICI axes), JOB_DATA_PATH (token shards; synthetic data when unset),
+JOB_CHECKPOINT_DIR, JOB_CHECKPOINT_EVERY.
 """
 
 from __future__ import annotations
@@ -35,11 +37,17 @@ def main() -> None:
         f"accelerator={denv.accelerator_type} topology={denv.slice_topology}")
 
     from tpu_kubernetes.models import CONFIGS, param_count
-    from tpu_kubernetes.parallel import create_mesh, mesh_shape_for_devices
+    from tpu_kubernetes.parallel import (
+        create_hybrid_mesh,
+        create_mesh,
+        mesh_shape_for_devices,
+    )
     from tpu_kubernetes.train import (
         TrainConfig,
         init_state,
+        input_pipeline,
         make_sharded_train_step,
+        prefetch,
         synthetic_batches,
     )
     from tpu_kubernetes.train.checkpoint import CheckpointError, latest_step, restore, save
@@ -51,13 +59,29 @@ def main() -> None:
     seq = int(os.environ.get("JOB_SEQ", str(cfg.max_seq)))
     steps = int(os.environ.get("JOB_STEPS", "100"))
     mesh_spec = os.environ.get("JOB_MESH", "")
+    dcn_spec = os.environ.get("JOB_DCN_MESH", "")
+    data_path = os.environ.get("JOB_DATA_PATH", "")
     ckpt_dir = os.environ.get("JOB_CHECKPOINT_DIR", "")
     ckpt_every = int(os.environ.get("JOB_CHECKPOINT_EVERY", "50"))
 
     from tpu_kubernetes.topology import parse_mesh_shape
 
-    shape = parse_mesh_shape(mesh_spec) if mesh_spec else mesh_shape_for_devices(n)
-    mesh = create_mesh(shape)
+    if dcn_spec:
+        # multislice: DCN axes cross slices, JOB_MESH covers one slice.
+        # The slice count comes from the dcn shape the operator supplied —
+        # authoritative even where MEGASCALE_* env is absent (local runs)
+        dcn_shape = parse_mesh_shape(dcn_spec)
+        n_slices = 1
+        for v in dcn_shape.values():
+            n_slices *= v
+        ici = (
+            parse_mesh_shape(mesh_spec) if mesh_spec
+            else mesh_shape_for_devices(n // n_slices)
+        )
+        mesh = create_hybrid_mesh(ici, dcn_shape)
+    else:
+        shape = parse_mesh_shape(mesh_spec) if mesh_spec else mesh_shape_for_devices(n)
+        mesh = create_mesh(shape)
     log(f"devices={n} mesh={dict(mesh.shape)} model={model} "
         f"batch={batch} seq={seq}")
 
@@ -78,12 +102,23 @@ def main() -> None:
         except CheckpointError as e:
             log(f"no resume: {e}")
 
-    batches = synthetic_batches(cfg.vocab_size, batch, seq)
+    if data_path:
+        # start_step skips already-consumed batches on checkpoint resume
+        batches = input_pipeline(
+            data_path, batch, seq, cfg.vocab_size, b_sharding,
+            start_step=start_step,
+        )
+        log(f"data: {data_path} (from step {start_step})")
+    else:
+        batches = prefetch(
+            jax.device_put(b, b_sharding)
+            for b in synthetic_batches(cfg.vocab_size, batch, seq)
+        )
+        log("data: synthetic")
     first_step_done = False
     t_last = time.time()
     for i in range(start_step, steps):
-        batch_arr = jax.device_put(next(batches), b_sharding)
-        state, loss = step_fn(state, batch_arr)
+        state, loss = step_fn(state, next(batches))
         if not first_step_done:
             jax.block_until_ready(loss)
             log(f"FIRST TRAIN STEP at +{time.time() - t_start:.1f}s "
