@@ -153,6 +153,39 @@ class TestPrefetch:
     def test_depth_zero_passthrough(self):
         assert list(prefetch(iter([1, 2]), depth=0)) == [1, 2]
 
+    def test_abandoned_consumer_releases_worker(self):
+        """Closing the generator mid-stream must unblock the worker even
+        on an infinite source with a full queue."""
+        import itertools
+        import threading
+        import time
+
+        produced = []
+
+        def source():
+            for i in itertools.count():
+                produced.append(i)
+                yield i
+
+        it = prefetch(source(), depth=2)
+        assert next(it) == 0
+        it.close()  # GeneratorExit → stop event
+        n_after_close = len(produced)
+        time.sleep(0.5)
+        # worker parked at most one extra item after release, not unbounded
+        assert len(produced) <= n_after_close + 1
+        assert threading.active_count() < 50  # no thread pile-up
+
+
+class TestHybridMeshTrivialAxes:
+    def test_size_one_ici_axis_composes_with_dcn(self):
+        """The auto-mesh default includes data=1; it must compose with a
+        DCN data axis rather than collide (job.py JOB_MESH-unset path)."""
+        from tpu_kubernetes.parallel import mesh_shape_for_devices
+
+        mesh = create_hybrid_mesh(mesh_shape_for_devices(4), {"data": 2})
+        assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "tensor": 2}
+
 
 class TestHybridMesh:
     def test_dcn_by_ici_shape_and_order(self):

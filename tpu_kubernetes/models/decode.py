@@ -1,0 +1,237 @@
+"""Autoregressive inference: KV-cache prefill + decode for both model
+families (dense LLaMA and MoE).
+
+TPU-first decisions:
+
+* **Static shapes end to end.** The cache is allocated at ``max_seq`` up
+  front; the position is a traced scalar and writes are
+  ``dynamic_update_slice`` — one compile covers the whole generation.
+* **One program.** ``generate`` is a single jittable function: prefill
+  (full-sequence forward that also emits the cache via ``lax.scan`` ys)
+  followed by a ``lax.scan`` of single-token decode steps with sampling
+  folded in. No Python-level token loop, no host round-trips.
+* **Cache in KV heads.** GQA caches ``n_kv_heads`` (memory ∝ kv), heads
+  are repeated at use — the broadcast folds into the attention einsum.
+* Decode attention is plain masked dot-product against the cache (a
+  single query token has no O(seq²) problem — flash buys nothing there);
+  prefill reuses the training forward path (flash/Pallas on TPU).
+
+MoE semantics: the routed layer runs per chunk (the prompt in prefill,
+one token per decode step), so expert-capacity dropping — whose threshold
+scales with the chunk's length — effectively never fires at decode time
+(single-token chunks always fit). That is the standard inference choice:
+capacity dropping is a training-time batching artifact, and decode
+matches the training forward exactly whenever nothing would be dropped
+(see tests/test_decode.py for the precise equivalence statement).
+
+The reference provisioner has no inference plane; this completes the
+in-tree model stack (training + serving entry points on the same params).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_kubernetes.models.llama import ModelConfig
+from tpu_kubernetes.models.moe import MoEConfig, moe_sublayer
+from tpu_kubernetes.ops import (
+    apply_rope,
+    flash_attention,
+    rms_norm,
+    rope_frequencies,
+)
+
+
+class KVCache(NamedTuple):
+    """Stacked per-layer cache: k/v are (n_layers, batch, kv_heads,
+    max_seq, head_dim); length is the number of valid positions."""
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array  # () int32
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_seq: int | None = None) -> KVCache:
+    s = max_seq or cfg.max_seq
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, s, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+        length=jnp.zeros((), jnp.int32),
+    )
+
+
+def _mlp(cfg: ModelConfig, x: jax.Array, layer: dict) -> jax.Array:
+    """The post-attention sublayer for either family (residual included)."""
+    if isinstance(cfg, MoEConfig):
+        out, _ = moe_sublayer(cfg, x, layer)
+        return out
+    y = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gated = jax.nn.silu(y @ layer["w_gate"]) * (y @ layer["w_up"])
+    return x + gated @ layer["w_down"]
+
+
+def _attend_cache(cfg, q, k_cache, v_cache, valid_len):
+    """Decode-side attention only: q (b, h, 1, d) against the cache
+    (b, kv, S, d); positions ≥ valid_len masked. Prefill goes through the
+    training flash kernel instead (full-sequence causal)."""
+    h, kv = cfg.n_heads, cfg.n_kv_heads
+    if kv != h:
+        rep = h // kv
+        k_cache = jnp.repeat(k_cache, rep, axis=1)
+        v_cache = jnp.repeat(v_cache, rep, axis=1)
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32),
+        k_cache.astype(jnp.float32),
+    ) * (1.0 / (cfg.head_dim ** 0.5))
+    mask = jnp.arange(k_cache.shape[2]) < valid_len          # (S,)
+    s = jnp.where(mask[None, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v_cache.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _decode_block(cfg, cos, sin, pos, x, layer, k_cache, v_cache):
+    """One layer, one token. x: (b, 1, d); caches (b, kv, S, d) updated at
+    ``pos``. → (x, k_cache, v_cache)."""
+    b = x.shape[0]
+    h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    y = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (y @ layer["wq"]).reshape(b, 1, h, hd).transpose(0, 2, 1, 3)
+    k = (y @ layer["wk"]).reshape(b, 1, kv, hd).transpose(0, 2, 1, 3)
+    v = (y @ layer["wv"]).reshape(b, 1, kv, hd).transpose(0, 2, 1, 3)
+    positions = pos[None]                                    # (1,)
+    q = apply_rope(q, cos, sin, positions=positions)
+    k = apply_rope(k, cos, sin, positions=positions)
+
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
+
+    attn = _attend_cache(cfg, q, k_cache, v_cache, pos + 1)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, h * hd)
+    x = x + attn @ layer["wo"]
+    return _mlp(cfg, x, layer), k_cache, v_cache
+
+
+def prefill(
+    params: dict, tokens: jax.Array, cfg: ModelConfig,
+    max_seq: int | None = None,
+) -> tuple[jax.Array, KVCache]:
+    """Process the whole prompt at once. tokens: (batch, prompt_len) →
+    (last-position logits (batch, vocab) f32, filled cache)."""
+    b, plen = tokens.shape
+    S = max_seq or cfg.max_seq
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    x = params["embed"][tokens]
+
+    def block(x, layer):
+        y = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = (y @ layer["wq"]).reshape(b, plen, h, hd).transpose(0, 2, 1, 3)
+        k = (y @ layer["wk"]).reshape(b, plen, kv, hd).transpose(0, 2, 1, 3)
+        v = (y @ layer["wv"]).reshape(b, plen, kv, hd).transpose(0, 2, 1, 3)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # cache this layer's K/V padded out to S
+        pad = [(0, 0), (0, 0), (0, S - plen), (0, 0)]
+        k_full = jnp.pad(k, pad)
+        v_full = jnp.pad(v, pad)
+        kq = k
+        vq = v
+        if kv != h:
+            rep = h // kv
+            kq = jnp.repeat(kq, rep, axis=1)
+            vq = jnp.repeat(vq, rep, axis=1)
+        # the same flash kernel as training (Pallas on TPU, XLA reference
+        # elsewhere) — prefill is a full-sequence causal forward
+        attn = flash_attention(
+            q, kq, vq, causal=True,
+            block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
+            use_pallas=cfg.use_pallas,
+        )
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, plen, h * hd)
+        x = x + attn @ layer["wo"]
+        return _mlp(cfg, x, layer), (k_full, v_full)
+
+    x, (k_cache, v_cache) = jax.lax.scan(block, x, params["layers"])
+
+    x = rms_norm(x[:, -1], params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    cache = KVCache(k=k_cache, v=v_cache, length=jnp.asarray(plen, jnp.int32))
+    return logits, cache
+
+
+def decode_step(
+    params: dict, cache: KVCache, token: jax.Array, cfg: ModelConfig
+) -> tuple[jax.Array, KVCache]:
+    """One token for the whole batch. token: (batch,) int32 at position
+    ``cache.length`` → (logits (batch, vocab) f32, cache advanced by 1)."""
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    pos = cache.length
+    x = params["embed"][token][:, None, :]                   # (b, 1, d)
+
+    def block(x, xs):
+        layer, k_c, v_c = xs
+        x, k_c, v_c = _decode_block(cfg, cos, sin, pos, x, layer, k_c, v_c)
+        return x, (k_c, v_c)
+
+    x, (k_new, v_new) = jax.lax.scan(block, x, (params["layers"], cache.k, cache.v))
+
+    x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, KVCache(k=k_new, v=v_new, length=pos + 1)
+
+
+def _sample(logits: jax.Array, rng: jax.Array, temperature: float, top_k: int):
+    """(batch, vocab) f32 → (batch,) int32. temperature 0 = greedy."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(
+    params: dict,
+    prompt: jax.Array,
+    cfg: ModelConfig,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """prompt (batch, prompt_len) int32 → (batch, max_new_tokens) int32.
+    Jittable end to end (prefill + lax.scan of decode steps with sampling
+    folded in); wrap in jax.jit with static cfg/max_new_tokens for a
+    single compiled serving program."""
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    plen = prompt.shape[1]
+    if plen + max_new_tokens > cfg.max_seq:
+        raise ValueError(
+            f"prompt {plen} + new {max_new_tokens} exceeds max_seq {cfg.max_seq}"
+        )
+
+    rng, first_rng = jax.random.split(rng)
+    # right-size the cache: decode attends over plen+max_new positions,
+    # not cfg.max_seq (static per compile, same as max_new_tokens)
+    logits, cache = prefill(params, prompt, cfg, max_seq=plen + max_new_tokens)
+    first = _sample(logits, first_rng, temperature, top_k)
+
+    def step(carry, step_rng):
+        cache, token = carry
+        logits, cache = decode_step(params, cache, token, cfg)
+        nxt = _sample(logits, step_rng, temperature, top_k)
+        return (cache, nxt), nxt
+
+    rngs = jax.random.split(rng, max_new_tokens - 1)
+    _, rest = jax.lax.scan(step, (cache, first), rngs)  # (max_new-1, batch)
+    return jnp.concatenate([first[:, None], rest.T], axis=1)

@@ -131,9 +131,11 @@ def create_hybrid_mesh(
 
     Devices are grouped into slices by their ``slice_index`` attribute
     (real multislice TPU) or evenly by order (CPU test meshes). DCN axes
-    vary slowest; an axis may not appear in both shapes."""
-    ici_shape = {k: v for k, v in ici_shape.items() if v != 0}
-    dcn_shape = {k: v for k, v in dcn_shape.items() if v != 0}
+    vary slowest; an axis may not appear (non-trivially) in both shapes.
+    Size-1 axes are dropped — so the auto-mesh default ``data=1`` composes
+    with a DCN ``data`` axis instead of colliding with it."""
+    ici_shape = {k: v for k, v in ici_shape.items() if v not in (0, 1)}
+    dcn_shape = {k: v for k, v in dcn_shape.items() if v not in (0, 1)}
     overlap = set(ici_shape) & set(dcn_shape)
     if overlap:
         raise ValueError(

@@ -137,30 +137,48 @@ def prefetch(
 ) -> Iterator[Any]:
     """Stage up to ``depth`` items ahead on a background thread so host
     work (file reads, device transfer dispatch) overlaps the running
-    step. Exceptions re-raise at the consumption point."""
+    step. Exceptions re-raise at the consumption point. When the consumer
+    stops early (generator close / GeneratorExit), the worker is released
+    — it would otherwise block forever on a full queue, pinning staged
+    batches for the process lifetime."""
     if depth < 1:
         yield from it
         return
     q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
     _END = object()
+
+    def _put(item) -> bool:
+        """Bounded put that gives up once the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker():
         try:
             for item in it:
-                q.put(item)
-            q.put(_END)
+                if not _put(item):
+                    return
+            _put(_END)
         except BaseException as e:  # noqa: BLE001 — re-raised in consumer
-            q.put(e)
+            _put(e)
 
     t = threading.Thread(target=worker, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
 
 
 def input_pipeline(
