@@ -72,6 +72,24 @@ def test_sharded_matches_single_device():
     assert abs(float(loss1) - float(loss8)) < 1e-3
 
 
+def test_eval_step_matches_loss_and_preserves_state():
+    from tpu_kubernetes.models import loss_fn
+    from tpu_kubernetes.train import make_eval_step
+
+    mesh = create_mesh({"data": 2, "fsdp": 2, "tensor": 2})
+    state = init_state(jax.random.PRNGKey(0), CFG, TC)
+    step, shardings, b_shard = make_sharded_train_step(CFG, TC, mesh, state)
+    state = jax.device_put(state, shardings)
+    eval_step, eb_shard = make_eval_step(CFG, mesh, state)
+    batch = next(synthetic_batches(CFG.vocab_size, 4, 64))
+    ref = float(loss_fn(jax.device_get(state["params"]), batch, CFG))
+    got = float(eval_step(state["params"], jax.device_put(batch, eb_shard)))
+    assert abs(got - ref) < 1e-3
+    # nothing donated: params still usable afterwards
+    _, train_loss = step(state, jax.device_put(batch, b_shard))
+    assert np.isfinite(float(train_loss))
+
+
 def test_checkpoint_roundtrip(tmp_path):
     from tpu_kubernetes.train import checkpoint as ckpt_mod  # noqa: F401
     from tpu_kubernetes.train.checkpoint import latest_step, restore, save

@@ -11,6 +11,7 @@ from tpu_kubernetes.train.data import (  # noqa: F401
 from tpu_kubernetes.train.trainer import (  # noqa: F401
     TrainConfig,
     init_state,
+    make_eval_step,
     make_optimizer,
     make_pipeline_train_step,
     make_sharded_train_step,

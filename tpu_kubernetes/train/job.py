@@ -12,7 +12,8 @@ Env knobs: JOB_MODEL (default llama-7b), JOB_BATCH (global), JOB_SEQ,
 JOB_STEPS, JOB_MESH ("data=1,fsdp=16,tensor=1"), JOB_DCN_MESH (multislice:
 cross-slice axes, e.g. "data=2" — JOB_MESH then describes the intra-slice
 ICI axes), JOB_DATA_PATH (token shards; synthetic data when unset),
-JOB_CHECKPOINT_DIR, JOB_CHECKPOINT_EVERY.
+JOB_CHECKPOINT_DIR, JOB_CHECKPOINT_EVERY, JOB_EVAL_DATA_PATH +
+JOB_EVAL_EVERY/JOB_EVAL_BATCHES (held-out loss/perplexity).
 """
 
 from __future__ import annotations
@@ -46,6 +47,7 @@ def main() -> None:
         TrainConfig,
         init_state,
         input_pipeline,
+        make_eval_step,
         make_sharded_train_step,
         prefetch,
         synthetic_batches,
@@ -63,6 +65,9 @@ def main() -> None:
     data_path = os.environ.get("JOB_DATA_PATH", "")
     ckpt_dir = os.environ.get("JOB_CHECKPOINT_DIR", "")
     ckpt_every = int(os.environ.get("JOB_CHECKPOINT_EVERY", "50"))
+    eval_path = os.environ.get("JOB_EVAL_DATA_PATH", "")
+    eval_every = int(os.environ.get("JOB_EVAL_EVERY", "50"))
+    eval_batches = int(os.environ.get("JOB_EVAL_BATCHES", "8"))
 
     from tpu_kubernetes.topology import parse_mesh_shape
 
@@ -115,6 +120,22 @@ def main() -> None:
             for b in synthetic_batches(cfg.vocab_size, batch, seq)
         )
         log("data: synthetic")
+    def run_eval(at_step: int) -> None:
+        """Mean held-out loss over a fixed eval prefix (seed-pinned, so
+        every eval sees the same batches)."""
+        import math
+
+        eval_step, eb_sharding = make_eval_step(cfg, mesh, state)
+        it = input_pipeline(
+            eval_path, batch, seq, cfg.vocab_size, eb_sharding,
+            seed=1, prefetch_depth=1,
+        )
+        total = 0.0
+        for _ in range(eval_batches):
+            total += float(eval_step(state["params"], next(it)))
+        mean = total / eval_batches
+        log(f"eval step={at_step} loss={mean:.4f} ppl={math.exp(min(mean, 30)):.2f}")
+
     first_step_done = False
     t_last = time.time()
     for i in range(start_step, steps):
@@ -130,6 +151,8 @@ def main() -> None:
             tps = 10 * batch * seq / (now - t_last)
             log(f"step={i + 1} loss={float(loss):.4f} tokens/s={tps:.0f}")
             t_last = now
+        if eval_path and (i + 1) % eval_every == 0:
+            run_eval(i + 1)
         if ckpt_dir and (i + 1) % ckpt_every == 0:
             # orbax save of cross-host sharded arrays is a collective —
             # EVERY process must enter it (matching the restore path above)

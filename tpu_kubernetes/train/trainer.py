@@ -143,6 +143,25 @@ def make_pipeline_train_step(
     )
 
 
+def make_eval_step(
+    cfg: ModelConfig, mesh: Mesh, state: dict[str, Any]
+) -> tuple[Callable, NamedSharding]:
+    """→ (jitted eval step, batch sharding): loss-only forward over the
+    same mesh/shardings as training, nothing donated (params survive)."""
+    shardings = state_shardings(state, cfg, mesh)
+    b_sharding = batch_sharding(mesh)
+
+    def eval_step(params, batch):
+        return loss_fn(params, batch, cfg)
+
+    step = jax.jit(
+        eval_step,
+        in_shardings=(shardings["params"], b_sharding),
+        out_shardings=NamedSharding(mesh, PartitionSpec()),
+    )
+    return step, b_sharding
+
+
 def synthetic_batches(
     vocab_size: int, batch: int, seq: int, seed: int = 0
 ) -> Iterator[jax.Array]:
