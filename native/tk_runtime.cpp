@@ -118,8 +118,11 @@ int tk_run_streaming(const char *const argv[], const char *cwd,
   }
 
   // parent: forward terminal signals to the child's process group for the
-  // duration of the run (see g_child_pgid above)
+  // duration of the run (see g_child_pgid above). Both sides setpgid so
+  // there is no window where kill(-pgid) targets a group that does not
+  // exist yet; EACCES after the child exec'd means the child already did it.
   close(pipefd[1]);
+  setpgid(pid, pid);
   g_child_pgid = pid;
   struct sigaction fwd = {}, old_int = {}, old_term = {};
   fwd.sa_handler = forward_signal;

@@ -79,6 +79,13 @@ def test_flash_fits_oversized_blocks_to_seq():
     assert _fit_block(512, 640) == 128
     assert _fit_block(512, 2048) == 512
     assert _fit_block(512, 8) == 8
+    # degenerate fits (no halving ≥16 divides seq) hand back the original
+    # block so the caller's divisibility check raises — a silent sub-16
+    # block is below the bf16 min tile and can fail Pallas lowering
+    assert _fit_block(512, 1000) == 512
+    q1, k1, v1 = qkv(seq=1000)
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q1, k1, v1, block_q=512, block_k=512, interpret=True)
     q, k, v = qkv(seq=192)
     out = flash_attention(
         q, k, v, causal=True, block_q=512, block_k=512, interpret=True

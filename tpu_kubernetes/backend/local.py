@@ -180,7 +180,16 @@ class LocalBackend(Backend):
                 with self._flock(name):
                     if json.loads(path.read_bytes()).get("owner") == owner:
                         path.unlink()
-            except (ValueError, OSError, LockError):
+            except LockError:
+                # flock acquisition timed out during a clean unlock — still
+                # delete our own lock unguarded (the pre-native behavior)
+                # rather than strand contenders until the TTL expires
+                try:
+                    if json.loads(path.read_bytes()).get("owner") == owner:
+                        path.unlink()
+                except (ValueError, OSError):
+                    pass
+            except (ValueError, OSError):
                 pass
 
     def __repr__(self) -> str:

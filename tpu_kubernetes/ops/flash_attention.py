@@ -365,9 +365,14 @@ def _fit_block(block: int, seq: int) -> int:
     sequence lengths the smaller default accepted. Degenerate fits
     (< 16 — pathological for the MXU) fall through to the caller's
     divisibility error instead."""
-    block = min(block, seq)
+    orig = block = min(block, seq)
     while block >= 16 and seq % block:
         block //= 2
+    if block < 16 and block < seq:
+        # no halving ≥ the bf16 min sublane tile divides seq (e.g. 1000):
+        # hand back the original so the caller's divisibility check raises
+        # instead of silently lowering a sub-16 block Pallas can reject
+        return orig
     return block
 
 

@@ -35,7 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from jax import shard_map  # requires jax ≥ 0.8 (pcast below does too)
 
 from tpu_kubernetes.models import ModelConfig
-from tpu_kubernetes.models.llama import _block
+from tpu_kubernetes.models.llama import _block, remat_policy_kwargs
 from tpu_kubernetes.ops import next_token_nll, rms_norm, rope_frequencies
 from tpu_kubernetes.parallel.mesh import (
     DEFAULT_RULES,
@@ -57,7 +57,7 @@ def _pipeline_body(
     def run_stage(act):
         block = lambda x, layer: (_block(cfg, cos, sin, x, layer), None)
         if cfg.remat:
-            block = jax.checkpoint(block)
+            block = jax.checkpoint(block, **remat_policy_kwargs(cfg))
         out, _ = jax.lax.scan(block, act, layers)
         return out
 

@@ -120,19 +120,29 @@ def main() -> None:
             for b in synthetic_batches(cfg.vocab_size, batch, seq)
         )
         log("data: synthetic")
-    def run_eval(at_step: int) -> None:
-        """Mean held-out loss over a fixed eval prefix (seed-pinned, so
-        every eval sees the same batches)."""
-        import math
-
+    eval_step = None
+    eval_data: list = []
+    if eval_path:
+        # Built ONCE before the loop: a fresh jit closure per eval would be
+        # a full XLA recompile every JOB_EVAL_EVERY steps, and the pipeline
+        # would re-open memmaps + a prefetch thread each time. The fixed
+        # seed-pinned prefix is materialized so every eval sees the same
+        # batches (already device_put against the eval sharding).
         eval_step, eb_sharding = make_eval_step(cfg, mesh, state)
-        it = input_pipeline(
+        eval_it = input_pipeline(
             eval_path, batch, seq, cfg.vocab_size, eb_sharding,
             seed=1, prefetch_depth=1,
         )
+        eval_data = [next(eval_it) for _ in range(eval_batches)]
+        eval_it.close()  # release the prefetch thread + memmaps
+
+    def run_eval(at_step: int) -> None:
+        """Mean held-out loss over the fixed eval prefix."""
+        import math
+
         total = 0.0
-        for _ in range(eval_batches):
-            total += float(eval_step(state["params"], next(it)))
+        for eb in eval_data:
+            total += float(eval_step(state["params"], eb))
         mean = total / eval_batches
         log(f"eval step={at_step} loss={mean:.4f} ppl={math.exp(min(mean, 30)):.2f}")
 
