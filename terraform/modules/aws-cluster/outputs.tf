@@ -25,3 +25,10 @@ output "aws_security_group_id" {
 output "aws_key_name" {
   value = aws_key_pair.cluster.key_name
 }
+
+output "server_token" {
+  # k3s server token for control/etcd quorum joins, published by the manager
+  # at bootstrap (install_manager.sh.tpl) and forwarded by register_cluster.sh
+  value     = data.external.register_cluster.result.server_token
+  sensitive = true
+}

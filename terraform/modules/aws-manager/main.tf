@@ -87,10 +87,11 @@ resource "aws_instance" "manager" {
 data "external" "api_key" {
   depends_on = [aws_instance.manager]
   program = ["sh", "-c", <<-EOT
-    ssh -o StrictHostKeyChecking=no ${aws_instance.manager.public_ip} \
+    ssh -o StrictHostKeyChecking=no -i ${pathexpand(var.aws_private_key_path)} \
+      ${var.aws_ssh_user}@${aws_instance.manager.public_ip} \
       'printf "{\"access_key\": \"%s\", \"secret_key\": \"%s\"}" \
-        "$(cat ~/.tpu-kubernetes/api_access_key)" \
-        "$(cat ~/.tpu-kubernetes/api_secret_key)"'
+        "$(sudo -n cat /etc/tpu-kubernetes/api_access_key 2>/dev/null || cat /etc/tpu-kubernetes/api_access_key)" \
+        "$(sudo -n cat /etc/tpu-kubernetes/api_secret_key 2>/dev/null || cat /etc/tpu-kubernetes/api_secret_key)"'
   EOT
   ]
 }

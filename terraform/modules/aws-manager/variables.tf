@@ -52,3 +52,13 @@ variable "private_registry_password" {
   default   = ""
   sensitive = true
 }
+
+variable "aws_ssh_user" {
+  description = "Login user of the AMI, used by the api-key scrape"
+  default     = "ubuntu"
+}
+
+variable "aws_private_key_path" {
+  description = "Private key matching aws_public_key_path, used by the api-key scrape"
+  default     = "~/.ssh/id_rsa"
+}

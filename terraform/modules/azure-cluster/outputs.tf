@@ -24,3 +24,10 @@ output "azure_subnet_id" {
 output "azure_network_security_group_id" {
   value = azurerm_network_security_group.cluster.id
 }
+
+output "server_token" {
+  # k3s server token for control/etcd quorum joins, published by the manager
+  # at bootstrap (install_manager.sh.tpl) and forwarded by register_cluster.sh
+  value     = data.external.register_cluster.result.server_token
+  sensitive = true
+}

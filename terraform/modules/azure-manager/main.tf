@@ -108,10 +108,11 @@ resource "azurerm_linux_virtual_machine" "manager" {
 data "external" "api_key" {
   depends_on = [azurerm_linux_virtual_machine.manager]
   program = ["sh", "-c", <<-EOT
-    ssh -o StrictHostKeyChecking=no ${var.azure_ssh_user}@${azurerm_public_ip.manager.ip_address} \
+    ssh -o StrictHostKeyChecking=no -i ${pathexpand(var.azure_private_key_path)} \
+      ${var.azure_ssh_user}@${azurerm_public_ip.manager.ip_address} \
       'printf "{\"access_key\": \"%s\", \"secret_key\": \"%s\"}" \
-        "$(cat ~/.tpu-kubernetes/api_access_key)" \
-        "$(cat ~/.tpu-kubernetes/api_secret_key)"'
+        "$(sudo -n cat /etc/tpu-kubernetes/api_access_key 2>/dev/null || cat /etc/tpu-kubernetes/api_access_key)" \
+        "$(sudo -n cat /etc/tpu-kubernetes/api_secret_key 2>/dev/null || cat /etc/tpu-kubernetes/api_secret_key)"'
   EOT
   ]
 }

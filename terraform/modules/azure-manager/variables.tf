@@ -62,3 +62,8 @@ variable "private_registry_password" {
   default   = ""
   sensitive = true
 }
+
+variable "azure_private_key_path" {
+  description = "Private key matching azure_public_key_path, used by the api-key scrape"
+  default     = "~/.ssh/id_rsa"
+}

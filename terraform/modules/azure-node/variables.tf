@@ -80,3 +80,9 @@ variable "private_registry_password" {
   default   = ""
   sensitive = true
 }
+
+variable "server_token" {
+  description = "k3s server token for control/etcd quorum joins; empty for workers (their user-data is metadata-readable and must not carry the quorum credential)"
+  sensitive   = true
+  default     = ""
+}

@@ -14,3 +14,10 @@ output "registration_token" {
 output "ca_checksum" {
   value = data.external.register_cluster.result.ca_checksum
 }
+
+output "server_token" {
+  # k3s server token for control/etcd quorum joins, published by the manager
+  # at bootstrap (install_manager.sh.tpl) and forwarded by register_cluster.sh
+  value     = data.external.register_cluster.result.server_token
+  sensitive = true
+}

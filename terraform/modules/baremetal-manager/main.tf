@@ -37,8 +37,8 @@ data "external" "api_key" {
     ssh -o StrictHostKeyChecking=no -i ${pathexpand(var.key_path)} \
       ${var.ssh_user}@${var.host} \
       'printf "{\"access_key\": \"%s\", \"secret_key\": \"%s\"}" \
-        "$(cat ~/.tpu-kubernetes/api_access_key)" \
-        "$(cat ~/.tpu-kubernetes/api_secret_key)"'
+        "$(sudo -n cat /etc/tpu-kubernetes/api_access_key 2>/dev/null || cat /etc/tpu-kubernetes/api_access_key)" \
+        "$(sudo -n cat /etc/tpu-kubernetes/api_secret_key 2>/dev/null || cat /etc/tpu-kubernetes/api_secret_key)"'
   EOT
   ]
 }

@@ -5,6 +5,7 @@ locals {
   agent_script = templatefile("${path.module}/../files/install_node_agent.sh.tpl", {
     api_url            = var.api_url
     registration_token = var.registration_token
+    server_token       = var.server_token
     ca_checksum        = var.ca_checksum
     node_role          = var.node_role
     hostname           = var.hostname

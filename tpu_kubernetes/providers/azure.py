@@ -60,6 +60,10 @@ def build_manager(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
     out = base_manager_config(ctx, "azure")
     _azure_common(ctx, out)
     _azure_image(ctx, out)
+    # private key for the api-key scrape (manager module only)
+    out["azure_private_key_path"] = ctx.cfg.get(
+        "azure_private_key_path", default="~/.ssh/id_rsa"
+    )
     return out
 
 

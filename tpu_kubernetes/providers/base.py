@@ -168,6 +168,12 @@ def base_node_config(ctx: BuildContext, provider: str) -> dict[str, Any]:
         "ca_checksum": f"${{module.{ctx.cluster_key}.ca_checksum}}",
         "node_role": role,
     }
+    if role in ("control", "etcd"):
+        # quorum joins need the k3s SERVER token (bootstrap tokens only
+        # authenticate agents). Workers must never carry it: node user-data
+        # is readable from the instance metadata service, and this
+        # credential authorizes joining the control plane itself.
+        out["server_token"] = f"${{module.{ctx.cluster_key}.server_token}}"
     _maybe_private_registry(cfg, out)
     return out
 

@@ -67,6 +67,19 @@ def build_manager(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
         "gcp_machine_type", prompt="machine type", default=DEFAULT_MACHINE_TYPE
     )
     out["gcp_image"] = cfg.get("gcp_image", prompt="boot image", default=DEFAULT_IMAGE)
+    # SSH access for the api-key scrape + optional service account
+    # (reference: gcp-rancher/main.tf:50-57 sshKeys metadata)
+    out["gcp_ssh_user"] = cfg.get("gcp_ssh_user", default="ubuntu")
+    out["gcp_public_key_path"] = cfg.get(
+        "gcp_public_key_path", prompt="SSH public key path",
+        default="~/.ssh/id_rsa.pub",
+    )
+    out["gcp_private_key_path"] = cfg.get(
+        "gcp_private_key_path", default="~/.ssh/id_rsa"
+    )
+    sa = cfg.peek("gcp_service_account_email")
+    if sa:
+        out["gcp_service_account_email"] = sa
     return out
 
 

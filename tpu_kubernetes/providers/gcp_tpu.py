@@ -67,6 +67,9 @@ def build_cluster(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
 
 def build_node(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
     out = base_node_config(ctx, "gcp-tpu")
+    # TPU slices always join as workers; the control-plane quorum credential
+    # must never be shipped to slice hosts
+    out.pop("server_token", None)
     _gcp_common(ctx, out)
     cfg = ctx.cfg
 
