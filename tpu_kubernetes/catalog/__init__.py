@@ -1,0 +1,137 @@
+"""Cloud resource catalogs: discovery + validation with graceful degradation.
+
+The reference spends ~1.5 kLoC calling cloud SDKs mid-prompt — listing GCP
+regions/zones/machine-types/images (reference: create/manager_gcp.go:112-324),
+validating AWS AMIs/instance types (reference: create/node_aws.go:87-120),
+listing Triton networks/images/packages (reference:
+create/manager_triton.go:45-120) — which is precisely why those flows are
+untestable in its suite (SURVEY §4 gap).
+
+This layer keeps the capability but inverts the design:
+
+* one generic :class:`Catalog` surface (``choices``/``validate`` by *kind*)
+  instead of per-provider ad-hoc calls scattered through prompts;
+* every catalog DEGRADES to "unknown" (``None``) when credentials, SDKs, or
+  the network are absent — the workflows then accept input as given and let
+  ``terraform plan`` be the validator, keeping every test hermetic;
+* catalogs are constructed through a registry the tests (and users) can
+  override with :class:`FakeCatalog`.
+
+Kinds used by the providers: ``region`` ``zone`` ``machine_type`` ``image``
+``instance_type`` ``ami`` ``location`` ``size`` ``network`` ``package``
+``accelerator_type``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Protocol
+
+from tpu_kubernetes.config import Config
+
+
+class CatalogError(Exception):
+    """A *definitive* validation failure (the resource does not exist) —
+    distinct from degradation (catalog can't tell), which is never an
+    error."""
+
+
+class Catalog(Protocol):
+    def choices(self, kind: str, **scope: Any) -> list[str] | None:
+        """Known-valid values for ``kind`` (e.g. zone machine types), or
+        ``None`` when the catalog cannot tell (no creds / no network)."""
+        ...
+
+    def validate(self, kind: str, value: str, **scope: Any) -> str | None:
+        """``None`` when valid or unknown; an error message when the value
+        definitively does not exist."""
+        ...
+
+
+class NullCatalog:
+    """Knows nothing, validates nothing — the hermetic default."""
+
+    def choices(self, kind: str, **scope: Any) -> list[str] | None:
+        return None
+
+    def validate(self, kind: str, value: str, **scope: Any) -> str | None:
+        return None
+
+
+class FakeCatalog:
+    """Test/dry-run catalog: seeded with {kind: [values]}."""
+
+    def __init__(self, entries: dict[str, list[str]] | None = None):
+        self.entries = dict(entries or {})
+        self.queries: list[tuple[str, dict[str, Any]]] = []
+
+    def choices(self, kind: str, **scope: Any) -> list[str] | None:
+        self.queries.append((kind, scope))
+        return self.entries.get(kind)
+
+    def validate(self, kind: str, value: str, **scope: Any) -> str | None:
+        self.queries.append((kind, scope))
+        known = self.entries.get(kind)
+        if known is None or value in known:
+            return None
+        return f"{kind} {value!r} not found (known: {sorted(known)})"
+
+
+CatalogFactory = Callable[[Config], Catalog]
+_FACTORIES: dict[str, CatalogFactory] = {}
+
+
+def register_catalog_factory(provider: str, factory: CatalogFactory) -> None:
+    _FACTORIES[provider] = factory
+
+
+def get_catalog(provider: str, cfg: Config) -> Catalog:
+    """Construct the provider's catalog; any construction failure (missing
+    SDK, unreadable credentials) degrades to :class:`NullCatalog`.
+    Memoized on the Config object: construction can mean a blocking OAuth
+    token exchange, and the per-instance listing caches must survive across
+    the several calls one build makes."""
+    injected = cfg.peek("_catalog")
+    if injected is not None:  # test/dry-run injection
+        return injected
+    cache: dict[str, Catalog] | None = getattr(cfg, "_catalog_cache", None)
+    if cache is None:
+        cache = {}
+        setattr(cfg, "_catalog_cache", cache)
+    if provider not in cache:
+        factory = _FACTORIES.get(provider)
+        try:
+            cache[provider] = factory(cfg) if factory else NullCatalog()
+        except Exception:
+            cache[provider] = NullCatalog()
+    return cache[provider]
+
+
+def catalog_choices(
+    catalog: Catalog, kind: str, fallback: list[str] | None = None,
+    **scope: Any,
+) -> list[str] | None:
+    """Live choices when the catalog knows them, else ``fallback``."""
+    live = catalog.choices(kind, **scope)
+    return live if live else fallback
+
+
+def catalog_validate(catalog: Catalog, kind: str, value: str, **scope: Any) -> None:
+    """Raise :class:`CatalogError` on a definitive mismatch; silent when the
+    catalog cannot tell (hermetic runs validate nothing)."""
+    err = catalog.validate(kind, value, **scope)
+    if err is not None:
+        raise CatalogError(err)
+
+
+def _register_builtin_factories() -> None:
+    # deferred imports so an absent SDK never breaks `import tpu_kubernetes`
+    from tpu_kubernetes.catalog import aws, azure, gcp, triton
+
+    register_catalog_factory("gcp", gcp.factory)
+    register_catalog_factory("gcp-tpu", gcp.factory)
+    register_catalog_factory("aws", aws.factory)
+    register_catalog_factory("azure", azure.factory)
+    register_catalog_factory("triton", triton.factory)
+
+
+_register_builtin_factories()

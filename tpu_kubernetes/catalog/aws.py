@@ -1,0 +1,98 @@
+"""AWS catalog: AMI + instance-type validation, instance-type discovery.
+
+Reference analog: create/node_aws.go:87-120 — ``DescribeImages`` validates
+the AMI and ``DescribeInstanceTypeOfferings``-style listing backs the
+instance-type prompt. boto3 is optional: construction raises without it and
+``get_catalog`` degrades to the null catalog. The client is injectable so
+the logic is hermetically testable (the reference's equivalent is untested
+for exactly this reason).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpu_kubernetes.config import Config
+
+
+def _default_client(cfg: Config):
+    import boto3  # not baked into the image; degrade when absent
+
+    return boto3.client(
+        "ec2",
+        region_name=str(cfg.peek("aws_region") or "us-east-1"),
+        aws_access_key_id=str(cfg.peek("aws_access_key") or "") or None,
+        aws_secret_access_key=str(cfg.peek("aws_secret_key") or "") or None,
+    )
+
+
+class AwsCatalog:
+    """``client`` mirrors the boto3 EC2 client surface actually used:
+    ``describe_images``, ``describe_instance_type_offerings``."""
+
+    MAX_PAGES = 20
+
+    def __init__(self, client: Any):
+        self.client = client
+        self._types: tuple[list[str], bool] | None = None
+        self._types_fetched = False
+
+    def _instance_types(self) -> tuple[list[str], bool] | None:
+        """→ (types, complete), following NextToken (us-east-1 offers 800+
+        types — one page is NOT the universe)."""
+        if not self._types_fetched:
+            self._types_fetched = True
+            try:
+                names: list[str] = []
+                kwargs: dict[str, Any] = {"LocationType": "region"}
+                complete = False
+                for _ in range(self.MAX_PAGES):
+                    resp = self.client.describe_instance_type_offerings(**kwargs)
+                    names += [
+                        o["InstanceType"]
+                        for o in resp.get("InstanceTypeOfferings", [])
+                    ]
+                    token = resp.get("NextToken")
+                    if not token:
+                        complete = True
+                        break
+                    kwargs["NextToken"] = token
+                self._types = (sorted(names), complete) if names else None
+            except Exception:
+                self._types = None
+        return self._types
+
+    def choices(self, kind: str, **scope: Any) -> list[str] | None:
+        if kind != "instance_type":
+            return None
+        got = self._instance_types()
+        return got[0] if got else None
+
+    def validate(self, kind: str, value: str, **scope: Any) -> str | None:
+        if kind == "ami":
+            try:
+                resp = self.client.describe_images(ImageIds=[value])
+                images = resp.get("Images", [])
+            except Exception as e:
+                # InvalidAMIID.* is a definitive "no such image"; anything
+                # else (auth, network) is degradation, not failure
+                if "InvalidAMIID" in str(e):
+                    return f"AMI {value!r} does not exist in this region"
+                return None
+            if not images:
+                return f"AMI {value!r} does not exist in this region"
+            state = images[0].get("State", "available")
+            if state != "available":
+                return f"AMI {value!r} is not available (state: {state})"
+            return None
+        if kind == "instance_type":
+            got = self._instance_types()
+            if got is None or not got[1] or value in got[0]:
+                # incomplete listings never reject
+                return None
+            return f"instance type {value!r} is not offered in this region"
+        return None
+
+
+def factory(cfg: Config):
+    return AwsCatalog(_default_client(cfg))

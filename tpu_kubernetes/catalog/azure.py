@@ -1,0 +1,94 @@
+"""Azure catalog: location + VM-size discovery over plain ARM REST.
+
+Reference analog: the azure provider collects subscription/client/tenant
+credentials and location/size by prompt (reference:
+create/manager_azure.go:27-47) with the SDK underneath. No Azure SDK is
+assumed here: client-credentials OAuth against login.microsoftonline.com
+plus two management-plane GETs cover the discovery surface, with the
+session injectable for hermetic tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpu_kubernetes.config import Config
+
+LOGIN = "https://login.microsoftonline.com"
+ARM = "https://management.azure.com"
+API = "2023-07-01"
+
+
+def _default_session(cfg: Config):
+    import requests
+
+    tenant = cfg.peek("azure_tenant_id")
+    client = cfg.peek("azure_client_id")
+    secret = cfg.peek("azure_client_secret")
+    if not (tenant and client and secret):
+        raise LookupError("azure credentials not configured")
+    resp = requests.post(
+        f"{LOGIN}/{tenant}/oauth2/v2.0/token",
+        data={
+            "grant_type": "client_credentials",
+            "client_id": client,
+            "client_secret": secret,
+            "scope": f"{ARM}/.default",
+        },
+        timeout=15,
+    )
+    resp.raise_for_status()
+    session = requests.Session()
+    session.headers["Authorization"] = f"Bearer {resp.json()['access_token']}"
+    return session
+
+
+class AzureCatalog:
+    def __init__(self, subscription: str, session: Any):
+        self.subscription = subscription
+        self.session = session
+        self._cache: dict[tuple, list[str] | None] = {}
+
+    def _list(self, url: str, field: str = "name") -> list[str] | None:
+        try:
+            resp = self.session.get(url, timeout=15)
+            if resp.status_code != 200:
+                return None
+            return [it.get(field, "") for it in resp.json().get("value", [])] or None
+        except Exception:
+            return None
+
+    def choices(self, kind: str, **scope: Any) -> list[str] | None:
+        sub = self.subscription
+        if kind == "location":
+            key = ("loc",)
+            if key not in self._cache:
+                self._cache[key] = self._list(
+                    f"{ARM}/subscriptions/{sub}/locations?api-version={API}"
+                )
+            return self._cache[key]
+        if kind == "size":
+            location = scope.get("location")
+            if not location:
+                return None
+            key = ("size", location)
+            if key not in self._cache:
+                self._cache[key] = self._list(
+                    f"{ARM}/subscriptions/{sub}/providers/Microsoft.Compute"
+                    f"/locations/{location}/vmSizes?api-version={API}"
+                )
+            return self._cache[key]
+        return None
+
+    def validate(self, kind: str, value: str, **scope: Any) -> str | None:
+        known = self.choices(kind, **scope)
+        if known is None or value in known:
+            return None
+        return f"Azure {kind} {value!r} not found in subscription {self.subscription}"
+
+
+def factory(cfg: Config):
+    sub = cfg.peek("azure_subscription_id")
+    if not sub:
+        raise LookupError("azure subscription not configured")
+    return AzureCatalog(str(sub), _default_session(cfg))

@@ -1,0 +1,150 @@
+"""GCP catalog: compute + Cloud TPU REST discovery.
+
+Reference analog: create/manager_gcp.go:112-324 lists regions, zones,
+machine types, and images through the compute SDK mid-prompt. Here the same
+surface is plain REST (the SDK client isn't baked into minimal images) with
+an injectable session so the parsing is hermetically testable, plus a
+TPU-native addition the reference has no analog of: listing the
+``acceleratorTypes`` a zone actually offers (``tpu.googleapis.com``), so a
+``v5p-32`` typo or an unavailable generation is caught at prompt time, not
+after quota is burned.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpu_kubernetes.config import Config
+
+COMPUTE = "https://compute.googleapis.com/compute/v1"
+TPU = "https://tpu.googleapis.com/v2"
+_SCOPES = ["https://www.googleapis.com/auth/cloud-platform"]
+
+
+def _default_session(creds_path: str):
+    """OAuth'd requests session from a service-account file. Raises on any
+    missing dependency/credential — get_catalog degrades that to Null."""
+    import google.auth.transport.requests
+    import requests
+    from google.oauth2 import service_account
+
+    creds = service_account.Credentials.from_service_account_file(
+        creds_path, scopes=_SCOPES
+    )
+    creds.refresh(google.auth.transport.requests.Request())
+    session = requests.Session()
+    session.headers["Authorization"] = f"Bearer {creds.token}"
+    return session
+
+
+class GcpCatalog:
+    """``session`` needs only ``.get(url) -> resp`` with ``.status_code``
+    and ``.json()`` — tests inject a stub."""
+
+    def __init__(self, project: str, session: Any):
+        self.project = project
+        self.session = session
+        self._cache: dict[tuple, list[str] | None] = {}
+
+    MAX_PAGES = 20
+
+    def _list(
+        self, url: str, field: str = "name", items_key: str = "items"
+    ) -> tuple[list[str], bool] | None:
+        """→ (names, complete) following nextPageToken; None on any failure.
+        ``complete=False`` (page cap hit) means the list may only be used
+        for prompt choices, never to reject a value as nonexistent."""
+        try:
+            names: list[str] = []
+            token = ""
+            for _ in range(self.MAX_PAGES):
+                page_url = url + (f"?pageToken={token}" if token else "")
+                resp = self.session.get(page_url, timeout=15)
+                if resp.status_code != 200:
+                    return None
+                body = resp.json()
+                for it in body.get(items_key, []):
+                    name = it.get(field, "")
+                    # acceleratorTypes/locations come fully qualified:
+                    # …/acceleratorTypes/v5p-32 — keep the leaf
+                    names.append(name.rsplit("/", 1)[-1] if "/" in name else name)
+                token = body.get("nextPageToken", "")
+                if not token:
+                    return (names, True) if names else None
+            return (names, False) if names else None
+        except Exception:
+            return None
+
+    def _cached(
+        self, key: tuple, url: str, **kw
+    ) -> tuple[list[str], bool] | None:
+        if key not in self._cache:
+            self._cache[key] = self._list(url, **kw)
+        return self._cache[key]
+
+    def _lookup(self, kind: str, **scope: Any) -> tuple[list[str], bool] | None:
+        p = self.project
+        if kind == "region":
+            return self._cached(("region",), f"{COMPUTE}/projects/{p}/regions")
+        if kind == "zone":
+            region = scope.get("region")
+            got = self._cached(("zone",), f"{COMPUTE}/projects/{p}/zones")
+            if got and region:
+                zones = [z for z in got[0] if z.startswith(f"{region}-")]
+                return (zones, got[1]) if zones else None
+            return got
+        if kind == "machine_type":
+            zone = scope.get("zone")
+            if not zone:
+                return None
+            return self._cached(
+                ("mt", zone), f"{COMPUTE}/projects/{p}/zones/{zone}/machineTypes"
+            )
+        if kind == "image":
+            # family-qualified public images are what the prompts offer;
+            # project images are the custom/packer output
+            proj = scope.get("image_project", p)
+            return self._cached(
+                ("img", proj), f"{COMPUTE}/projects/{proj}/global/images"
+            )
+        if kind == "tpu_location":
+            # zones where the Cloud TPU API is present at all — the right
+            # prompt set for "TPU zone" (most GCE zones have no TPUs)
+            return self._cached(
+                ("tpuloc",), f"{TPU}/projects/{p}/locations",
+                field="locationId", items_key="locations",
+            )
+        if kind == "accelerator_type":
+            zone = scope.get("zone")
+            if not zone:
+                return None
+            return self._cached(
+                ("tpu", zone),
+                f"{TPU}/projects/{p}/locations/{zone}/acceleratorTypes",
+                items_key="acceleratorTypes",
+            )
+        return None
+
+    def choices(self, kind: str, **scope: Any) -> list[str] | None:
+        got = self._lookup(kind, **scope)
+        return got[0] if got else None
+
+    def validate(self, kind: str, value: str, **scope: Any) -> str | None:
+        got = self._lookup(kind, **scope)
+        if got is None or not got[1] or value in got[0]:
+            # unknown or INCOMPLETE listings never reject (a valid value
+            # could live past the page cap)
+            return None
+        hint = ", ".join(sorted(got[0])[:8])
+        return (
+            f"GCP {kind.replace('_', ' ')} {value!r} not found in project "
+            f"{self.project}" + (f" (e.g. {hint})" if hint else "")
+        )
+
+
+def factory(cfg: Config):
+    creds_path = cfg.peek("gcp_path_to_credentials")
+    project = cfg.peek("gcp_project_id")
+    if not creds_path or not project:
+        raise LookupError("gcp credentials/project not configured")
+    return GcpCatalog(str(project), _default_session(str(creds_path)))

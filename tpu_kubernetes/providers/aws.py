@@ -5,20 +5,24 @@ create/cluster_aws.go:29-41 (VPC/subnet CIDR, key pair),
 create/node_aws.go:28-58 (instance type, EBS volume options).
 
 The reference validates AMIs/instance types via aws-sdk-go mid-prompt
-(create/node_aws.go:87-120); validation here is left to terraform plan so
-the flow stays hermetic (same decision as the gcp provider).
+(create/node_aws.go:87-120); the same checks run here through the AWS
+catalog (tpu_kubernetes/catalog/aws.py) when boto3 + credentials exist, and
+degrade to terraform-plan-time validation hermetically.
 """
 
 from __future__ import annotations
 
 from typing import Any
 
+from tpu_kubernetes.catalog import CatalogError, catalog_validate, get_catalog
 from tpu_kubernetes.providers.base import (
     BuildContext,
     Provider,
+    ProviderError,
     base_cluster_config,
     base_manager_config,
     base_node_config,
+    catalog_get,
     register,
 )
 
@@ -39,6 +43,23 @@ def _aws_common(ctx: BuildContext, out: dict[str, Any]) -> None:
                                 default=DEFAULT_REGION)
 
 
+def _aws_instance(ctx: BuildContext, out: dict[str, Any]) -> None:
+    """AMI + instance type, validated like the reference does with the SDK
+    (create/node_aws.go:87-120) whenever the catalog can reach EC2."""
+    cfg = ctx.cfg
+    cat = get_catalog("aws", cfg)
+    ami = cfg.get("aws_ami_id", prompt="AMI id", default=DEFAULT_AMI)
+    try:
+        catalog_validate(cat, "ami", str(ami))
+    except CatalogError as e:
+        raise ProviderError(str(e)) from e
+    out["aws_ami_id"] = ami
+    out["aws_instance_type"] = catalog_get(
+        cfg, cat, "aws_instance_type", "instance_type",
+        prompt="instance type", default=DEFAULT_INSTANCE_TYPE,
+    )
+
+
 def build_manager(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
     """reference: create/manager_aws.go:29-47."""
     out = base_manager_config(ctx, "aws")
@@ -46,10 +67,7 @@ def build_manager(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
     cfg = ctx.cfg
     out["aws_vpc_cidr"] = cfg.get("aws_vpc_cidr", default=DEFAULT_VPC_CIDR)
     out["aws_subnet_cidr"] = cfg.get("aws_subnet_cidr", default=DEFAULT_SUBNET_CIDR)
-    out["aws_ami_id"] = cfg.get("aws_ami_id", prompt="AMI id", default=DEFAULT_AMI)
-    out["aws_instance_type"] = cfg.get(
-        "aws_instance_type", prompt="instance type", default=DEFAULT_INSTANCE_TYPE
-    )
+    _aws_instance(ctx, out)
     out["aws_public_key_path"] = cfg.get(
         "aws_public_key_path", prompt="SSH public key path",
         default="~/.ssh/id_rsa.pub",
@@ -82,10 +100,7 @@ def build_node(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
     out = base_node_config(ctx, "aws")
     _aws_common(ctx, out)
     cfg = ctx.cfg
-    out["aws_ami_id"] = cfg.get("aws_ami_id", prompt="AMI id", default=DEFAULT_AMI)
-    out["aws_instance_type"] = cfg.get(
-        "aws_instance_type", prompt="instance type", default=DEFAULT_INSTANCE_TYPE
-    )
+    _aws_instance(ctx, out)
     # optional EBS volume (reference: create/node_aws.go:28-38,52-58)
     ebs_gb = int(cfg.get("aws_ebs_volume_size_gb", default=0) or 0)
     if ebs_gb:

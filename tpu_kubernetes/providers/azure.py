@@ -35,8 +35,15 @@ def _azure_common(ctx: BuildContext, out: dict[str, Any]) -> None:
         "azure_client_secret", prompt="Azure client secret", secret=True
     )
     out["azure_tenant_id"] = cfg.get("azure_tenant_id", prompt="Azure tenant id")
-    out["azure_location"] = cfg.get(
-        "azure_location", prompt="Azure location", default=DEFAULT_LOCATION
+    # live location listing when ARM credentials work (reference does the
+    # equivalent through the SDK, create/manager_azure.go:49)
+    from tpu_kubernetes.catalog import get_catalog
+    from tpu_kubernetes.providers.base import catalog_get
+
+    cat = get_catalog("azure", cfg)
+    out["azure_location"] = catalog_get(
+        cfg, cat, "azure_location", "location",
+        prompt="Azure location", default=DEFAULT_LOCATION,
     )
 
 
@@ -47,7 +54,14 @@ def _azure_image(ctx: BuildContext, out: dict[str, Any]) -> None:
     )
     out["azure_image_offer"] = cfg.get("azure_image_offer", default=DEFAULT_IMAGE_OFFER)
     out["azure_image_sku"] = cfg.get("azure_image_sku", default=DEFAULT_IMAGE_SKU)
-    out["azure_size"] = cfg.get("azure_size", prompt="VM size", default=DEFAULT_SIZE)
+    from tpu_kubernetes.catalog import get_catalog
+    from tpu_kubernetes.providers.base import catalog_get
+
+    cat = get_catalog("azure", cfg)
+    out["azure_size"] = catalog_get(
+        cfg, cat, "azure_size", "size", prompt="VM size", default=DEFAULT_SIZE,
+        scope={"location": out.get("azure_location")},
+    )
     out["azure_ssh_user"] = cfg.get("azure_ssh_user", default="ubuntu")
     out["azure_public_key_path"] = cfg.get(
         "azure_public_key_path", prompt="SSH public key path",

@@ -24,6 +24,13 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Any, Callable
 
+from tpu_kubernetes.catalog import (
+    Catalog,
+    CatalogError,
+    catalog_choices,
+    catalog_validate,
+    get_catalog,
+)
 from tpu_kubernetes.config import Config
 from tpu_kubernetes.state import MANAGER_KEY, State
 from tpu_kubernetes.util import validate_name
@@ -187,6 +194,48 @@ def _maybe_private_registry(cfg: Config, out: dict[str, Any]) -> None:
         out["private_registry_password"] = cfg.get(
             "private_registry_password", secret=True
         )
+
+
+def catalog_require(
+    catalog: Catalog, kind: str, value: str, **scope: Any
+) -> None:
+    """catalog_validate, surfaced as the workflow-level ProviderError."""
+    try:
+        catalog_validate(catalog, kind, value, **scope)
+    except CatalogError as e:
+        raise ProviderError(str(e)) from e
+
+
+def catalog_get(
+    cfg: Config,
+    catalog: Catalog,
+    key: str,
+    kind: str,
+    *,
+    prompt: str,
+    default: Any,
+    scope: dict[str, Any] | None = None,
+    fallback_choices: list[str] | None = None,
+) -> Any:
+    """The reference's SDK-mid-prompt idiom (create/manager_gcp.go:112-324,
+    node_aws.go:87-120), catalog-backed and hermetic:
+
+    * value already configured → validate it against the catalog, which only
+      rejects DEFINITIVE mismatches (an unreachable/credential-less catalog
+      validates nothing — `terraform plan` stays the backstop);
+    * value to be prompted → offer the catalog's live choices, else
+      ``fallback_choices``, else free text with ``default``.
+    """
+    scope = scope or {}
+    if cfg.is_set(key):
+        value = cfg.get(key)
+        catalog_require(catalog, kind, str(value), **scope)
+        return value
+    choices = catalog_choices(catalog, kind, fallback_choices, **scope)
+    if choices and default not in choices:
+        # keep the static default reachable even when live listings exist
+        choices = [str(default), *choices]
+    return cfg.get(key, prompt=prompt, default=default, choices=choices)
 
 
 def prompt_name(

@@ -17,12 +17,14 @@ import json
 from pathlib import Path
 from typing import Any
 
+from tpu_kubernetes.catalog import get_catalog
 from tpu_kubernetes.providers.base import (
     BuildContext,
     Provider,
     base_cluster_config,
     base_manager_config,
     base_node_config,
+    catalog_get,
     register,
 )
 
@@ -52,9 +54,42 @@ def _gcp_common(ctx: BuildContext, out: dict[str, Any]) -> None:
     if derived is not None:
         cfg.set("gcp_project_id", cfg.peek("gcp_project_id", derived))
     out["gcp_project_id"] = cfg.get("gcp_project_id", prompt="GCP project id")
-    out["gcp_compute_region"] = cfg.get(
-        "gcp_compute_region", prompt="GCP compute region", default=DEFAULT_REGION
+    # live region listing when credentials work (reference:
+    # create/manager_gcp.go:112-140); static default hermetically
+    cat = get_catalog("gcp", cfg)
+    out["gcp_compute_region"] = catalog_get(
+        cfg, cat, "gcp_compute_region", "region",
+        prompt="GCP compute region", default=DEFAULT_REGION,
     )
+
+
+def _gcp_machine(ctx: BuildContext, out: dict[str, Any]) -> None:
+    """Zone / machine-type / image selection with live catalog listings when
+    credentials work (reference: create/manager_gcp.go:141-324)."""
+    cfg = ctx.cfg
+    cat = get_catalog("gcp", cfg)
+    region = out.get("gcp_compute_region")
+    out["gcp_zone"] = catalog_get(
+        cfg, cat, "gcp_zone", "zone", prompt="GCP zone", default=DEFAULT_ZONE,
+        scope={"region": region},
+    )
+    out["gcp_machine_type"] = catalog_get(
+        cfg, cat, "gcp_machine_type", "machine_type", prompt="machine type",
+        default=DEFAULT_MACHINE_TYPE, scope={"zone": out["gcp_zone"]},
+    )
+    image = cfg.get("gcp_image", prompt="boot image", default=DEFAULT_IMAGE)
+    if "/" not in str(image):
+        # unqualified = image in this project (e.g. packer output) — those
+        # the catalog can check; `project/family` strings it cannot
+        from tpu_kubernetes.catalog import CatalogError, catalog_validate
+
+        from tpu_kubernetes.providers.base import ProviderError
+
+        try:
+            catalog_validate(cat, "image", str(image))
+        except CatalogError as e:
+            raise ProviderError(str(e)) from e
+    out["gcp_image"] = image
 
 
 def build_manager(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
@@ -62,11 +97,7 @@ def build_manager(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
     out = base_manager_config(ctx, "gcp")
     _gcp_common(ctx, out)
     cfg = ctx.cfg
-    out["gcp_zone"] = cfg.get("gcp_zone", prompt="GCP zone", default=DEFAULT_ZONE)
-    out["gcp_machine_type"] = cfg.get(
-        "gcp_machine_type", prompt="machine type", default=DEFAULT_MACHINE_TYPE
-    )
-    out["gcp_image"] = cfg.get("gcp_image", prompt="boot image", default=DEFAULT_IMAGE)
+    _gcp_machine(ctx, out)
     # SSH access for the api-key scrape + optional service account
     # (reference: gcp-rancher/main.tf:50-57 sshKeys metadata)
     out["gcp_ssh_user"] = cfg.get("gcp_ssh_user", default="ubuntu")
@@ -96,11 +127,7 @@ def build_node(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
     out = base_node_config(ctx, "gcp")
     _gcp_common(ctx, out)
     cfg = ctx.cfg
-    out["gcp_zone"] = cfg.get("gcp_zone", prompt="GCP zone", default=DEFAULT_ZONE)
-    out["gcp_machine_type"] = cfg.get(
-        "gcp_machine_type", prompt="machine type", default=DEFAULT_MACHINE_TYPE
-    )
-    out["gcp_image"] = cfg.get("gcp_image", prompt="boot image", default=DEFAULT_IMAGE)
+    _gcp_machine(ctx, out)
     disk_gb = int(cfg.get("gcp_disk_size_gb", default=0) or 0)
     if disk_gb:
         out["gcp_disk_size_gb"] = disk_gb

@@ -21,12 +21,14 @@ from __future__ import annotations
 
 from typing import Any
 
+from tpu_kubernetes.catalog import CatalogError, catalog_validate, get_catalog
 from tpu_kubernetes.providers.base import (
     BuildContext,
     Provider,
     ProviderError,
     base_cluster_config,
     base_node_config,
+    catalog_get,
     register,
 )
 from tpu_kubernetes.providers.gcp import _gcp_common
@@ -73,6 +75,14 @@ def build_node(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
     _gcp_common(ctx, out)
     cfg = ctx.cfg
 
+    # zone first: the accelerator catalog is zone-scoped (what a zone
+    # actually offers differs per generation)
+    cat = get_catalog("gcp-tpu", cfg)
+    out["gcp_zone"] = catalog_get(
+        cfg, cat, "gcp_zone", "zone", prompt="TPU zone",
+        default=DEFAULT_TPU_ZONE,
+    )
+
     accel = cfg.get(
         "tpu_accelerator_type",
         prompt="TPU accelerator type",
@@ -83,6 +93,15 @@ def build_node(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
         topo = parse_accelerator_type(str(accel))
     except TopologyError as e:
         raise ProviderError(str(e)) from e
+    # render-time zone-capacity check (no reference analog — TPU types are
+    # zonal in a way GCE machine types aren't): validate the API name the
+    # slice will actually request against the zone's acceleratorTypes
+    try:
+        catalog_validate(
+            cat, "accelerator_type", topo.api_name, zone=out["gcp_zone"]
+        )
+    except CatalogError as e:
+        raise ProviderError(str(e)) from e
 
     mesh_spec = cfg.peek("mesh_shape")
     if mesh_spec:
@@ -90,8 +109,6 @@ def build_node(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
             validate_mesh(topo, parse_mesh_shape(str(mesh_spec)))
         except TopologyError as e:
             raise ProviderError(str(e)) from e
-
-    out["gcp_zone"] = cfg.get("gcp_zone", prompt="TPU zone", default=DEFAULT_TPU_ZONE)
     # the API string (v5e → v5litepod-N); canonical form kept alongside
     out["tpu_accelerator_type"] = topo.api_name
     out["tpu_topology"] = topo.topology

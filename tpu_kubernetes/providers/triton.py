@@ -65,15 +65,32 @@ def _triton_common(ctx: BuildContext, out: dict[str, Any]) -> None:
 
 
 def _triton_instance(ctx: BuildContext, out: dict[str, Any]) -> None:
-    """Networks/image/package for any Triton machine (manager or node)."""
+    """Networks/image/package for any Triton machine (manager or node),
+    listed live from CloudAPI when the account key works (reference:
+    create/manager_triton.go:45-120 via triton-go)."""
+    from tpu_kubernetes.catalog import CatalogError, catalog_validate, get_catalog
+    from tpu_kubernetes.providers.base import catalog_get
+
     cfg = ctx.cfg
+    cat = get_catalog("triton", cfg)
     networks = cfg.get("triton_network_names", default="Joyent-SDC-Public")
     if isinstance(networks, str):
         networks = [n.strip() for n in networks.split(",") if n.strip()]
+    for net in networks:
+        try:
+            catalog_validate(cat, "network", str(net))
+        except CatalogError as e:
+            raise ProviderError(str(e)) from e
     out["triton_network_names"] = networks
-    out["triton_image_name"] = cfg.get("triton_image_name", default=DEFAULT_IMAGE)
-    out["triton_machine_package"] = cfg.get(
-        "triton_machine_package", prompt="machine package", default=DEFAULT_PACKAGE
+    image = cfg.get("triton_image_name", default=DEFAULT_IMAGE)
+    try:
+        catalog_validate(cat, "image", str(image))
+    except CatalogError as e:
+        raise ProviderError(str(e)) from e
+    out["triton_image_name"] = image
+    out["triton_machine_package"] = catalog_get(
+        cfg, cat, "triton_machine_package", "package",
+        prompt="machine package", default=DEFAULT_PACKAGE,
     )
 
 
