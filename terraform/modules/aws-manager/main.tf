@@ -75,8 +75,13 @@ resource "aws_instance" "manager" {
   key_name               = aws_key_pair.manager.key_name
 
   user_data = templatefile("${path.module}/../files/install_manager.sh.tpl", {
-    admin_password = var.admin_password
-    manager_name   = var.name
+    admin_password                = var.admin_password
+    manager_name                  = var.name
+    k8s_version                   = var.k8s_version
+    network_provider              = var.k8s_network_provider
+    private_registry_b64          = base64encode(var.private_registry)
+    private_registry_username_b64 = base64encode(var.private_registry_username)
+    private_registry_password_b64 = base64encode(var.private_registry_password)
   })
 
   tags = {

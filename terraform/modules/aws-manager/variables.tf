@@ -62,3 +62,13 @@ variable "aws_private_key_path" {
   description = "Private key matching aws_public_key_path, used by the api-key scrape"
   default     = "~/.ssh/id_rsa"
 }
+
+variable "k8s_version" {
+  description = "Fleet control-plane kubernetes version (docs/design/topology.md)"
+  default     = "v1.31.1"
+}
+
+variable "k8s_network_provider" {
+  description = "Fleet-wide CNI: calico | flannel | cilium"
+  default     = "calico"
+}

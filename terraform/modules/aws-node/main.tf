@@ -16,13 +16,25 @@ resource "aws_instance" "node" {
   key_name               = var.aws_key_name
 
   user_data = templatefile("${path.module}/../files/install_node_agent.sh.tpl", {
-    api_url            = var.api_url
-    registration_token = var.registration_token
-    server_token       = var.server_token
-    ca_checksum        = var.ca_checksum
-    node_role          = var.node_role
-    hostname           = var.hostname
-    extra_labels       = ""
+    api_url                       = var.api_url
+    registration_token            = var.registration_token
+    server_token                  = var.server_token
+    ca_checksum                   = var.ca_checksum
+    node_role                     = var.node_role
+    hostname                      = var.hostname
+    extra_labels                  = ""
+    k8s_version                   = var.k8s_version
+    server_k8s_version            = var.server_k8s_version
+    network_provider              = var.network_provider
+    private_registry_b64          = base64encode(var.private_registry)
+    private_registry_username_b64 = base64encode(var.private_registry_username)
+    private_registry_password_b64 = base64encode(var.private_registry_password)
+    # candidate list: /dev/sdf is the attachment name; Xen instances rename
+    # to xvdf; on Nitro, EBS surfaces as an unpredictable nvme index, so use
+    # the stable by-id links (EBS-only — instance-store SSDs get a different
+    # prefix and must never be picked: the script also excludes partitioned/
+    # mounted disks, which covers the root EBS volume)
+    data_disk_device = var.aws_ebs_volume_size_gb > 0 ? "/dev/sdf /dev/xvdf /dev/disk/by-id/nvme-Amazon_Elastic_Block_Store_vol*" : ""
   })
 
   tags = {

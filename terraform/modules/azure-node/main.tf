@@ -34,6 +34,26 @@ resource "azurerm_network_interface_security_group_association" "node" {
   network_security_group_id = var.azure_network_security_group_id
 }
 
+# managed data disk (reference: azure-rancher-k8s-host/main.tf:34-110); lun 0
+# surfaces it at /dev/disk/azure/scsi1/lun0 for the bootstrap mkfs+mount
+resource "azurerm_managed_disk" "data" {
+  count                = var.azure_data_disk_size_gb > 0 ? 1 : 0
+  name                 = "${var.hostname}-data"
+  location             = var.azure_location
+  resource_group_name  = var.azure_resource_group_name
+  storage_account_type = "Premium_LRS"
+  create_option        = "Empty"
+  disk_size_gb         = var.azure_data_disk_size_gb
+}
+
+resource "azurerm_virtual_machine_data_disk_attachment" "data" {
+  count              = var.azure_data_disk_size_gb > 0 ? 1 : 0
+  managed_disk_id    = azurerm_managed_disk.data[0].id
+  virtual_machine_id = azurerm_linux_virtual_machine.node.id
+  lun                = 0
+  caching            = "ReadWrite"
+}
+
 resource "azurerm_linux_virtual_machine" "node" {
   name                  = var.hostname
   location              = var.azure_location
@@ -61,13 +81,20 @@ resource "azurerm_linux_virtual_machine" "node" {
 
   custom_data = base64encode(templatefile(
     "${path.module}/../files/install_node_agent.sh.tpl", {
-      api_url            = var.api_url
-      registration_token = var.registration_token
-      server_token       = var.server_token
-      ca_checksum        = var.ca_checksum
-      node_role          = var.node_role
-      hostname           = var.hostname
-      extra_labels       = ""
+      api_url                       = var.api_url
+      registration_token            = var.registration_token
+      server_token                  = var.server_token
+      ca_checksum                   = var.ca_checksum
+      node_role                     = var.node_role
+      hostname                      = var.hostname
+      extra_labels                  = ""
+      k8s_version                   = var.k8s_version
+      server_k8s_version            = var.server_k8s_version
+      network_provider              = var.network_provider
+      private_registry_b64          = base64encode(var.private_registry)
+      private_registry_username_b64 = base64encode(var.private_registry_username)
+      private_registry_password_b64 = base64encode(var.private_registry_password)
+      data_disk_device              = var.azure_data_disk_size_gb > 0 ? "/dev/disk/azure/scsi1/lun0" : ""
     }
   ))
 }

@@ -46,3 +46,13 @@ variable "private_registry_password" {
   default   = ""
   sensitive = true
 }
+
+variable "k8s_version" {
+  description = "Fleet control-plane kubernetes version (docs/design/topology.md)"
+  default     = "v1.31.1"
+}
+
+variable "k8s_network_provider" {
+  description = "Fleet-wide CNI: calico | flannel | cilium"
+  default     = "calico"
+}

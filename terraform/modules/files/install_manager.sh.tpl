@@ -7,16 +7,65 @@
 # docker + rancher/server (minutes of image pulls), a single k3s server
 # install — the control plane the clusters register with. Much faster boot,
 # which matters for the create→first-train-step target (<15 min).
+#
+# The manager's k3s IS the fleet control plane (docs/design/topology.md):
+# k8s_version and network_provider are therefore honored HERE — the server
+# version pins the fleet API version and the CNI is a fleet-wide choice
+# (reference analog: create/cluster.go:349-399, where each Rancher cluster
+# chooses its own; our shared-plane design hoists both to the manager).
 set -eu
+
+# YAML single-quote escaping for config-supplied strings
+sq() { printf "%s" "$1" | sed "s/'/''/g"; }
 
 ADMIN_PASSWORD="${admin_password}"
 MANAGER_NAME="${manager_name}"
+K8S_VERSION="${k8s_version}"
+NETWORK_PROVIDER="${network_provider}"
+PRIVATE_REGISTRY=$(printf '%s' "${private_registry_b64}" | base64 -d)
+PRIVATE_REGISTRY_USERNAME=$(printf '%s' "${private_registry_username_b64}" | base64 -d)
+PRIVATE_REGISTRY_PASSWORD=$(printf '%s' "${private_registry_password_b64}" | base64 -d)
 
-# 1. install k3s server (pinned channel for reproducibility)
+# 0a. private registry: k3s pulls its images through registries.yaml
+#     (reference analog: install_docker_rancher.sh.tpl:11-16 docker login)
+if [ -n "$PRIVATE_REGISTRY" ]; then
+  mkdir -p /etc/rancher/k3s
+  # values are attacker-controllable config: YAML single-quoted scalars with
+  # quote doubling, never shell-expanded content (credentials arrived base64)
+  cat > /etc/rancher/k3s/registries.yaml <<EOF
+mirrors:
+  docker.io:
+    endpoint:
+      - 'https://$(sq "$PRIVATE_REGISTRY")'
+configs:
+  '$(sq "$PRIVATE_REGISTRY")':
+    auth:
+      username: '$(sq "$PRIVATE_REGISTRY_USERNAME")'
+      password: '$(sq "$PRIVATE_REGISTRY_PASSWORD")'
+EOF
+  chmod 600 /etc/rancher/k3s/registries.yaml
+fi
+
+# 0b. CNI selection (fleet-wide; docs/design/topology.md). calico/cilium
+#     replace k3s's built-in flannel, so the server starts with its backend
+#     disabled; the manifest is applied once the API is up (step 3).
+cni_flags=""
+case "$NETWORK_PROVIDER" in
+  calico|cilium)
+    cni_flags="--flannel-backend=none --disable-network-policy" ;;
+  flannel|"")
+    ;;
+  *)
+    echo "unknown network provider '$NETWORK_PROVIDER'" >&2; exit 1 ;;
+esac
+
+# 1. install k3s server, pinned to the configured kubernetes version
+#    (v1.31.1 → k3s release v1.31.1+k3s1)
 if ! command -v k3s >/dev/null 2>&1; then
-  curl -sfL https://get.k3s.io | INSTALL_K3S_CHANNEL=v1.31 sh -s - server \
+  curl -sfL https://get.k3s.io | INSTALL_K3S_VERSION="$K8S_VERSION+k3s1" sh -s - server \
     --cluster-init \
-    --node-label tpu-kubernetes/role=manager
+    --node-label tpu-kubernetes/role=manager \
+    $cni_flags
 fi
 
 # 2. wait for the API to come up (reference analog:
@@ -27,11 +76,42 @@ until k3s kubectl get --raw /readyz >/dev/null 2>&1; do
   sleep 2
 done
 
-# 3. install the fleet registry (cluster inventory lives in the manager's own
+# 3. CNI manifest (airgap-first: the packer image bakes it under
+#    /opt/tpu-kubernetes/manifests; fall back to the pinned upstream URL)
+apply_manifest() { # $1=local path  $2=fallback URL
+  if [ -f "$1" ]; then
+    k3s kubectl apply -f "$1"
+  else
+    k3s kubectl apply -f "$2"
+  fi
+}
+case "$NETWORK_PROVIDER" in
+  calico)
+    apply_manifest /opt/tpu-kubernetes/manifests/calico.yaml \
+      https://raw.githubusercontent.com/projectcalico/calico/v3.28.1/manifests/calico.yaml ;;
+  cilium)
+    # cilium ships no standalone install manifest post-1.10 (helm/cli only)
+    # — it is airgap-only here: the packer image must bake one
+    if [ -f /opt/tpu-kubernetes/manifests/cilium.yaml ]; then
+      k3s kubectl apply -f /opt/tpu-kubernetes/manifests/cilium.yaml
+    else
+      echo "cilium requires a baked manifest at /opt/tpu-kubernetes/manifests/cilium.yaml (build the image with packer/) — or choose calico/flannel" >&2
+      exit 1
+    fi ;;
+esac
+
+# 4. install the fleet registry (cluster inventory lives in the manager's own
 #    kube API as ConfigMaps under this namespace — the Rancher-analog store)
 k3s kubectl create namespace tpu-fleet --dry-run=client -o yaml | k3s kubectl apply -f -
 
-# 4. mint API credentials: a long-lived ServiceAccount token with rights over
+# 5. JobSet controller, so TPU slice jobs (jobset.x-k8s.io/v1alpha2) are
+#    schedulable the moment the manager is up — the workload-ready guarantee
+#    the reference gets from the rancher/agent path (reference:
+#    install_rancher_agent.sh.tpl:44 delivers a workload-ready cluster)
+apply_manifest /opt/tpu-kubernetes/manifests/jobset.yaml \
+  https://github.com/kubernetes-sigs/jobset/releases/download/v0.8.0/manifests.yaml
+
+# 6. mint API credentials: a long-lived ServiceAccount token with rights over
 #    the fleet namespace (replaces the reference's ssh-scrape hack,
 #    reference: gcp-rancher/main.tf:149-163)
 k3s kubectl -n tpu-fleet create serviceaccount fleet-admin \
@@ -56,7 +136,7 @@ until [ -n "$(k3s kubectl -n tpu-fleet get secret fleet-admin-token -o jsonpath=
   sleep 1
 done
 
-# 5. publish the REAL k3s join credentials into the fleet store so
+# 7. publish the REAL k3s join credentials into the fleet store so
 #    register_cluster.sh hands out tokens the supervisor actually honors:
 #    the server token authorizes control/etcd quorum joins; per-cluster
 #    worker tokens are minted as bootstrap tokens at registration time
@@ -67,7 +147,7 @@ k3s kubectl -n tpu-fleet create secret generic join-credentials \
   --from-literal=server_token="$SERVER_TOKEN" \
   --dry-run=client -o yaml | k3s kubectl apply -f -
 
-# 6. drop credentials where the api-key scrape can read them
+# 8. drop credentials where the api-key scrape can read them
 #    (reference analog: setup_rancher.sh.tpl writes ~/rancher_api_key).
 #    Fixed path, not $HOME: this script runs as root via startup-script/
 #    user-data, while the scrape sshes in as the image's login user — a

@@ -1,5 +1,5 @@
 #!/bin/sh
-# Join one node to its cluster's control plane.
+# Join one node to the fleet control plane.
 #
 # Reference analog: install_rancher_agent.sh.tpl (reference:
 # gcp-rancher-k8s-host/files/install_rancher_agent.sh.tpl:1-44) — install
@@ -9,7 +9,17 @@
 # Ours joins via k3s: control/etcd roles run `k3s server` joining the HA
 # control plane; workers run `k3s agent`. The (api_url, registration_token,
 # ca_checksum) trio is the same contract (SURVEY §5.8).
+#
+# Version semantics (docs/design/topology.md): control/etcd nodes join the
+# MANAGER's server quorum, so they install the manager's k8s version
+# (server_k8s_version) — mixed server versions in one etcd quorum are not a
+# supported k3s state. Workers are kubelets; they install their CLUSTER's
+# k8s_version, which render-time validation keeps within the kubelet skew
+# window of the manager (providers/base.py).
 set -eu
+
+# YAML single-quote escaping for config-supplied strings
+sq() { printf "%s" "$1" | sed "s/'/''/g"; }
 
 API_URL="${api_url}"
 TOKEN="${registration_token}"   # per-cluster bootstrap token (worker joins)
@@ -18,9 +28,77 @@ CA_CHECKSUM="${ca_checksum}"
 ROLE="${node_role}"          # worker | etcd | control
 HOSTNAME_OVERRIDE="${hostname}"
 EXTRA_LABELS="${extra_labels}"  # comma-separated k=v, may be empty
+K8S_VERSION="${k8s_version}"             # cluster (kubelet) version
+SERVER_K8S_VERSION="${server_k8s_version}" # manager (server) version
+NETWORK_PROVIDER="${network_provider}"
+PRIVATE_REGISTRY=$(printf '%s' "${private_registry_b64}" | base64 -d)
+PRIVATE_REGISTRY_USERNAME=$(printf '%s' "${private_registry_username_b64}" | base64 -d)
+PRIVATE_REGISTRY_PASSWORD=$(printf '%s' "${private_registry_password_b64}" | base64 -d)
+DATA_DISK_DEVICE="${data_disk_device}"  # e.g. /dev/sdf; empty = no data disk
 
 hostnamectl set-hostname "$HOSTNAME_OVERRIDE" 2>/dev/null || \
   hostname "$HOSTNAME_OVERRIDE" || true
+
+# optional data disk: mkfs (first boot only) + mount under k3s's data dir so
+# images/volumes land on it (reference analog: the agent script's mkfs+mount,
+# aws-rancher-k8s-host/files/install_rancher_agent.sh.tpl:26-45).
+# DATA_DISK_DEVICE is a space-separated CANDIDATE list: cloud device naming
+# is not stable (EC2 /dev/sdf surfaces as /dev/xvdf on Xen, /dev/nvme1n1 on
+# Nitro), so the first candidate that materializes wins. The attachment is a
+# separate terraform resource racing this boot script — wait up to 10 min,
+# then degrade to the boot disk LOUDLY rather than never joining the fleet
+# (a lost node is strictly worse than a misplaced data dir).
+if [ -n "$DATA_DISK_DEVICE" ]; then
+  disk=""
+  i=0
+  while [ -z "$disk" ] && [ $i -le 300 ]; do
+    # candidates may be globs (EBS by-id links). A candidate must be a whole,
+    # unpartitioned, unmounted disk: that excludes the root volume (has
+    # partitions) and anything already in use — never mkfs the wrong disk.
+    for d in $DATA_DISK_DEVICE; do
+      [ -b "$d" ] || continue
+      dev=$(readlink -f "$d")
+      ls "$dev"p* >/dev/null 2>&1 && continue
+      grep -q "^$dev " /proc/mounts && continue
+      disk="$dev"; break
+    done
+    [ -n "$disk" ] || sleep 2
+    i=$((i+1))
+  done
+  if [ -z "$disk" ]; then
+    echo "WARNING: data disk ($DATA_DISK_DEVICE) never appeared; continuing on the boot disk" >&2
+    mkdir -p /etc/tpu-kubernetes
+    touch /etc/tpu-kubernetes/data-disk-missing
+  else
+    if ! blkid "$disk" >/dev/null 2>&1; then
+      mkfs.ext4 -F "$disk"
+    fi
+    mkdir -p /var/lib/rancher
+    if ! grep -q "^$disk " /etc/fstab; then
+      echo "$disk /var/lib/rancher ext4 defaults,nofail 0 2" >> /etc/fstab
+    fi
+    mountpoint -q /var/lib/rancher || mount "$disk" /var/lib/rancher
+  fi
+fi
+
+# private registry (reference analog: install_docker_rancher.sh.tpl:11-16)
+if [ -n "$PRIVATE_REGISTRY" ]; then
+  mkdir -p /etc/rancher/k3s
+  # values are attacker-controllable config: YAML single-quoted scalars with
+  # quote doubling, never shell-expanded content (credentials arrived base64)
+  cat > /etc/rancher/k3s/registries.yaml <<EOF
+mirrors:
+  docker.io:
+    endpoint:
+      - 'https://$(sq "$PRIVATE_REGISTRY")'
+configs:
+  '$(sq "$PRIVATE_REGISTRY")':
+    auth:
+      username: '$(sq "$PRIVATE_REGISTRY_USERNAME")'
+      password: '$(sq "$PRIVATE_REGISTRY_PASSWORD")'
+EOF
+  chmod 600 /etc/rancher/k3s/registries.yaml
+fi
 
 # verify the control plane CA before joining (reference pins --ca-checksum)
 actual=$(curl -ks "$API_URL/cacerts" | sha256sum | cut -d' ' -f1)
@@ -36,6 +114,14 @@ if [ -n "$EXTRA_LABELS" ]; then
   done
 fi
 
+# a joining server must start with the same critical flags as the quorum it
+# joins — in particular the CNI backend choice (only the server branch
+# consumes these)
+cni_flags=""
+case "$NETWORK_PROVIDER" in
+  calico|cilium) cni_flags="--flannel-backend=none --disable-network-policy" ;;
+esac
+
 case "$ROLE" in
   control|etcd)
     # reference maps control→controlplane (gcp-rancher-k8s-host/main.tf:22);
@@ -46,11 +132,11 @@ case "$ROLE" in
       echo "role $ROLE requires a server token but none was provided" >&2
       exit 1
     fi
-    curl -sfL https://get.k3s.io | INSTALL_K3S_CHANNEL=v1.31 sh -s - server \
-      --server "$API_URL" --token "$SERVER_TOKEN" $labels
+    curl -sfL https://get.k3s.io | INSTALL_K3S_VERSION="$SERVER_K8S_VERSION+k3s1" sh -s - server \
+      --server "$API_URL" --token "$SERVER_TOKEN" $labels $cni_flags
     ;;
   worker)
-    curl -sfL https://get.k3s.io | INSTALL_K3S_CHANNEL=v1.31 sh -s - agent \
+    curl -sfL https://get.k3s.io | INSTALL_K3S_VERSION="$K8S_VERSION+k3s1" sh -s - agent \
       --server "$API_URL" --token "$TOKEN" $labels
     ;;
   *)

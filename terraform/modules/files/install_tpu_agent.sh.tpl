@@ -11,6 +11,9 @@
 # facts a JAX process needs to join the slice collective (SURVEY §5.8).
 set -eu
 
+# YAML single-quote escaping for config-supplied strings
+sq() { printf "%s" "$1" | sed "s/'/''/g"; }
+
 API_URL="${api_url}"
 TOKEN="${registration_token}"
 CA_CHECKSUM="${ca_checksum}"
@@ -19,6 +22,10 @@ ACCELERATOR_TYPE="${accelerator_type}"
 SLICE_TOPOLOGY="${slice_topology}"
 NUM_HOSTS="${num_hosts}"
 COORDINATOR_PORT="${coordinator_port}"
+K8S_VERSION="${k8s_version}"
+PRIVATE_REGISTRY=$(printf '%s' "${private_registry_b64}" | base64 -d)
+PRIVATE_REGISTRY_USERNAME=$(printf '%s' "${private_registry_username_b64}" | base64 -d)
+PRIVATE_REGISTRY_PASSWORD=$(printf '%s' "${private_registry_password_b64}" | base64 -d)
 
 md() { # TPU VM metadata helper
   curl -s -H 'Metadata-Flavor: Google' \
@@ -47,20 +54,40 @@ EOF
 ( set -a; . /etc/tpu-kubernetes/jax.env; set +a
   env | grep -E '^(JAX_|TPU_)' | sed 's/^/export /' > /etc/profile.d/tpu-kubernetes.sh )
 
-# 2. join the cluster as a worker labeled with the slice identity so JobSet /
-#    gang scheduling can target whole slices
+# 2. private registry (reference analog: install_docker_rancher.sh.tpl:11-16)
+if [ -n "$PRIVATE_REGISTRY" ]; then
+  mkdir -p /etc/rancher/k3s
+  # values are attacker-controllable config: YAML single-quoted scalars with
+  # quote doubling, never shell-expanded content (credentials arrived base64)
+  cat > /etc/rancher/k3s/registries.yaml <<EOF
+mirrors:
+  docker.io:
+    endpoint:
+      - 'https://$(sq "$PRIVATE_REGISTRY")'
+configs:
+  '$(sq "$PRIVATE_REGISTRY")':
+    auth:
+      username: '$(sq "$PRIVATE_REGISTRY_USERNAME")'
+      password: '$(sq "$PRIVATE_REGISTRY_PASSWORD")'
+EOF
+  chmod 600 /etc/rancher/k3s/registries.yaml
+fi
+
+# 3. join the cluster as a worker labeled with the slice identity so JobSet /
+#    gang scheduling can target whole slices; kubelet pinned to the cluster's
+#    k8s_version (docs/design/topology.md)
 actual=$(curl -ks "$API_URL/cacerts" | sha256sum | cut -d' ' -f1)
 if [ -n "$CA_CHECKSUM" ] && [ "$actual" != "$CA_CHECKSUM" ]; then
   echo "CA checksum mismatch" >&2; exit 1
 fi
-curl -sfL https://get.k3s.io | INSTALL_K3S_CHANNEL=v1.31 sh -s - agent \
+curl -sfL https://get.k3s.io | INSTALL_K3S_VERSION="$K8S_VERSION+k3s1" sh -s - agent \
   --server "$API_URL" --token "$TOKEN" \
   --node-label tpu-kubernetes/role=worker \
   --node-label tpu-kubernetes/accelerator="$ACCELERATOR_TYPE" \
   --node-label tpu-kubernetes/slice="$SLICE_NAME" \
   --node-label tpu-kubernetes/slice-host="$WORKER_ID"
 
-# 3. health-gate: verify libtpu sees the local chips before declaring ready
+# 4. health-gate: verify libtpu sees the local chips before declaring ready
 #    (SURVEY §5.3: TPU-VM readiness gate)
 python3 - <<'EOF' || { echo "TPU devices not visible" >&2; exit 1; }
 import glob, sys

@@ -66,3 +66,13 @@ variable "gcp_service_account_email" {
   description = "Service account attached to the VM (default compute SA when empty)"
   default     = ""
 }
+
+variable "k8s_version" {
+  description = "Fleet control-plane kubernetes version (docs/design/topology.md)"
+  default     = "v1.31.1"
+}
+
+variable "k8s_network_provider" {
+  description = "Fleet-wide CNI: calico | flannel | cilium"
+  default     = "calico"
+}

@@ -7,6 +7,17 @@ provider "google" {
   region      = var.gcp_compute_region
 }
 
+# detachable data disk (reference: gcp-rancher-k8s-host/main.tf:66-73);
+# device_name "data" surfaces it at /dev/disk/by-id/google-data for the
+# bootstrap script's mkfs+mount
+resource "google_compute_disk" "data" {
+  count = var.gcp_data_disk_size_gb > 0 ? 1 : 0
+  name  = "${var.hostname}-data"
+  type  = "pd-ssd"
+  zone  = var.gcp_zone
+  size  = var.gcp_data_disk_size_gb
+}
+
 resource "google_compute_instance" "node" {
   name         = var.hostname
   machine_type = var.gcp_machine_type
@@ -25,15 +36,37 @@ resource "google_compute_instance" "node" {
     access_config {}
   }
 
+  dynamic "attached_disk" {
+    for_each = google_compute_disk.data
+    content {
+      source      = attached_disk.value.self_link
+      device_name = "data"
+    }
+  }
+
+  # cloud-platform scope so workloads can reach GCP APIs — GCS checkpoints
+  # in particular (reference: gcp-rancher-k8s-host/main.tf:60-63)
+  service_account {
+    email  = var.gcp_service_account_email != "" ? var.gcp_service_account_email : null
+    scopes = ["cloud-platform"]
+  }
+
   metadata_startup_script = templatefile(
     "${path.module}/../files/install_node_agent.sh.tpl", {
-      api_url            = var.api_url
-      registration_token = var.registration_token
-      server_token       = var.server_token
-      ca_checksum        = var.ca_checksum
-      node_role          = var.node_role
-      hostname           = var.hostname
-      extra_labels       = ""
+      api_url                       = var.api_url
+      registration_token            = var.registration_token
+      server_token                  = var.server_token
+      ca_checksum                   = var.ca_checksum
+      node_role                     = var.node_role
+      hostname                      = var.hostname
+      extra_labels                  = ""
+      k8s_version                   = var.k8s_version
+      server_k8s_version            = var.server_k8s_version
+      network_provider              = var.network_provider
+      private_registry_b64          = base64encode(var.private_registry)
+      private_registry_username_b64 = base64encode(var.private_registry_username)
+      private_registry_password_b64 = base64encode(var.private_registry_password)
+      data_disk_device              = var.gcp_data_disk_size_gb > 0 ? "/dev/disk/by-id/google-data" : ""
     }
   )
 }

@@ -68,3 +68,32 @@ variable "server_token" {
   sensitive   = true
   default     = ""
 }
+
+variable "k8s_version" {
+  description = "Kubelet version for worker joins (cluster-scoped; docs/design/topology.md)"
+  default     = "v1.31.1"
+}
+
+variable "server_k8s_version" {
+  description = "Manager server version, installed by control/etcd quorum joins"
+  default     = "v1.31.1"
+}
+
+variable "network_provider" {
+  description = "Fleet CNI; a joining server must start with matching backend flags"
+  default     = "calico"
+}
+
+variable "gcp_data_disk_size_gb" {
+  description = "Detachable pd-ssd data disk, mounted at /var/lib/rancher (0 = none)"
+  default     = 0
+}
+
+variable "gcp_service_account_email" {
+  # NOTE: nodes always get cloud-platform OAuth scope (reference parity:
+  # gcp-rancher-k8s-host/main.tf:60-63) so workloads can reach GCS for
+  # checkpoints; restrict by attaching a least-privilege SA here — scope
+  # gating is deprecated by GCP in favor of SA IAM.
+  description = "Service account attached to the VM (default compute SA when empty)"
+  default     = ""
+}

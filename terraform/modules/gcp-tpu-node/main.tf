@@ -34,14 +34,18 @@ resource "google_tpu_v2_vm" "slice" {
   metadata = {
     startup-script = templatefile(
       "${path.module}/../files/install_tpu_agent.sh.tpl", {
-        api_url            = var.api_url
-        registration_token = var.registration_token
-        ca_checksum        = var.ca_checksum
-        slice_name         = var.hostname
-        accelerator_type   = var.tpu_accelerator_type
-        slice_topology     = var.tpu_topology
-        num_hosts          = var.tpu_hosts
-        coordinator_port   = var.tpu_coordinator_port
+        api_url                       = var.api_url
+        registration_token            = var.registration_token
+        ca_checksum                   = var.ca_checksum
+        slice_name                    = var.hostname
+        accelerator_type              = var.tpu_accelerator_type
+        slice_topology                = var.tpu_topology
+        num_hosts                     = var.tpu_hosts
+        coordinator_port              = var.tpu_coordinator_port
+        k8s_version                   = var.k8s_version
+        private_registry_b64          = base64encode(var.private_registry)
+        private_registry_username_b64 = base64encode(var.private_registry_username)
+        private_registry_password_b64 = base64encode(var.private_registry_password)
       }
     )
   }

@@ -81,3 +81,8 @@ variable "private_registry_password" {
   default   = ""
   sensitive = true
 }
+
+variable "k8s_version" {
+  description = "Kubelet version for the slice hosts (cluster-scoped)"
+  default     = "v1.31.1"
+}

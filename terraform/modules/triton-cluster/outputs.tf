@@ -21,3 +21,9 @@ output "server_token" {
   value     = data.external.register_cluster.result.server_token
   sensitive = true
 }
+
+output "k8s_version" {
+  # the cluster's kubelet version; workers install exactly this
+  # (docs/design/topology.md)
+  value = var.k8s_version
+}

@@ -40,8 +40,13 @@ resource "null_resource" "install_manager" {
 
   provisioner "remote-exec" {
     inline = [templatefile("${path.module}/../files/install_manager.sh.tpl", {
-      admin_password = var.admin_password
-      manager_name   = var.name
+      admin_password                = var.admin_password
+      manager_name                  = var.name
+      k8s_version                   = var.k8s_version
+      network_provider              = var.k8s_network_provider
+      private_registry_b64          = base64encode(var.private_registry)
+      private_registry_username_b64 = base64encode(var.private_registry_username)
+      private_registry_password_b64 = base64encode(var.private_registry_password)
     })]
   }
 }

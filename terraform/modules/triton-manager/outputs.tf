@@ -12,3 +12,13 @@ output "secret_key" {
   value     = data.external.api_key.result.secret_key
   sensitive = true
 }
+
+output "k8s_version" {
+  # the manager's server version IS the fleet API version
+  # (docs/design/topology.md); control/etcd joins install exactly this
+  value = var.k8s_version
+}
+
+output "k8s_network_provider" {
+  value = var.k8s_network_provider
+}

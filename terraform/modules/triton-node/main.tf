@@ -26,13 +26,20 @@ resource "triton_machine" "node" {
   networks = data.triton_network.node[*].id
 
   user_script = templatefile("${path.module}/../files/install_node_agent.sh.tpl", {
-    api_url            = var.api_url
-    registration_token = var.registration_token
-    server_token       = var.server_token
-    ca_checksum        = var.ca_checksum
-    node_role          = var.node_role
-    hostname           = var.hostname
-    extra_labels       = ""
+    api_url                       = var.api_url
+    registration_token            = var.registration_token
+    server_token                  = var.server_token
+    ca_checksum                   = var.ca_checksum
+    node_role                     = var.node_role
+    hostname                      = var.hostname
+    extra_labels                  = ""
+    k8s_version                   = var.k8s_version
+    server_k8s_version            = var.server_k8s_version
+    network_provider              = var.network_provider
+    private_registry_b64          = base64encode(var.private_registry)
+    private_registry_username_b64 = base64encode(var.private_registry_username)
+    private_registry_password_b64 = base64encode(var.private_registry_password)
+    data_disk_device              = ""
   })
 
   # per-role CNS service tag (reference: triton-rancher-k8s-host/main.tf:44-60)

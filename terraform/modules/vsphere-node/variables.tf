@@ -62,3 +62,18 @@ variable "server_token" {
   sensitive   = true
   default     = ""
 }
+
+variable "k8s_version" {
+  description = "Kubelet version for worker joins (cluster-scoped; docs/design/topology.md)"
+  default     = "v1.31.1"
+}
+
+variable "server_k8s_version" {
+  description = "Manager server version, installed by control/etcd quorum joins"
+  default     = "v1.31.1"
+}
+
+variable "network_provider" {
+  description = "Fleet CNI; a joining server must start with matching backend flags"
+  default     = "calico"
+}

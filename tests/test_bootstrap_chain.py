@@ -232,6 +232,13 @@ NODE_AGENT_VARS = dict(
     ca_checksum="f" * 64,
     hostname="node-1",
     extra_labels="",
+    k8s_version="v1.29.4",
+    server_k8s_version="v1.31.1",
+    network_provider="calico",
+    private_registry_b64="",
+    private_registry_username_b64="",
+    private_registry_password_b64="",
+    data_disk_device="",
 )
 
 
@@ -291,7 +298,10 @@ def test_workers_never_carry_the_quorum_credential():
 def test_manager_install_publishes_join_credentials(tmp_path):
     script = render_template_file(
         FILES / "install_manager.sh.tpl",
-        {"admin_password": "hunter2", "manager_name": "dev"},
+        {"admin_password": "hunter2", "manager_name": "dev",
+         "k8s_version": "v1.31.1", "network_provider": "calico",
+         "private_registry_b64": "", "private_registry_username_b64": "",
+         "private_registry_password_b64": ""},
     )
     sh_n(script, tmp_path, "manager.sh")
     # the published credential is k3s's own server token file, not invented
@@ -308,7 +318,9 @@ def test_tpu_agent_template_renders(tmp_path):
         dict(api_url="https://mgr:6443", registration_token="abcdef.0123",
              ca_checksum="f" * 64, slice_name="trainer-1",
              accelerator_type="v5p-32", slice_topology="2x2x4",
-             num_hosts=4, coordinator_port=8476),
+             num_hosts=4, coordinator_port=8476, k8s_version="v1.31.1",
+             private_registry_b64="", private_registry_username_b64="",
+             private_registry_password_b64=""),
     )
     sh_n(script, tmp_path, "tpu.sh")
     assert "jax.env" in script and "JAX_COORDINATOR_ADDRESS" in script

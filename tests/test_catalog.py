@@ -105,7 +105,8 @@ def test_interactive_gcp_manager_offers_live_zones(tmp_path):
             "gcp_path_to_credentials": str(creds),
             "_catalog": fake,
         },
-        answers=["us-central1", "us-central1-f", "c3-standard-8",
+        answers=["v1.31.1", "calico",  # fleet version + CNI (manager scope)
+                 "us-central1", "us-central1-f", "c3-standard-8",
                  "ubuntu-os-cloud/ubuntu-2204-lts", "~/.ssh/id_rsa.pub"],
     )
     from tpu_kubernetes.providers import get_provider

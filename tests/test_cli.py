@@ -48,6 +48,8 @@ manager_admin_password: hunter2
 host: 10.0.0.10
 ssh_user: ubuntu
 key_path: ~/.ssh/id_rsa
+k8s_network_provider: cilium
+image_has_cilium_manifest: true  # cilium is airgap-only (baked manifest)
 """
 
 TPU_CLUSTER_YAML = """

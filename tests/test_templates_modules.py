@@ -50,6 +50,15 @@ REPRESENTATIVE = {
     "slice_topology": "2x2x4",
     "num_hosts": 4,
     "coordinator_port": 8476,
+    "k8s_version": "v1.31.1",
+    "server_k8s_version": "v1.31.1",
+    "network_provider": "calico",
+    # registry values travel base64-encoded (shell-injection hardening;
+    # call sites wrap them in terraform base64encode())
+    "private_registry_b64": "cmVnaXN0cnkuZXhhbXBsZS5jb20=",
+    "private_registry_username_b64": "cHVsbGVy",
+    "private_registry_password_b64": "cHVsbC1zZWNyZXQ=",
+    "data_disk_device": "/dev/sdf",
 }
 
 TEMPLATES = sorted((MODULES / "files").glob("*.sh.tpl"))

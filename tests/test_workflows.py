@@ -156,7 +156,7 @@ TPU_CLUSTER_VALUES = {
     "cluster_cloud_provider": "gcp-tpu",
     "name": "tpu-alpha",
     "k8s_version": "v1.31.1",
-    "k8s_network_provider": "cilium",
+    "k8s_network_provider": "calico",
     "gcp_path_to_credentials": "/nonexistent/creds.json",
     "gcp_project_id": "proj-1",
     "gcp_compute_region": "us-east5",

@@ -95,6 +95,10 @@ def build_node(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
     out = base_node_config(ctx, "azure")
     _azure_common(ctx, out)
     _azure_image(ctx, out)
+    # managed data disk (reference: azure-rancher-k8s-host/main.tf:34-110)
+    data_gb = int(ctx.cfg.get("azure_data_disk_size_gb", default=0) or 0)
+    if data_gb:
+        out["azure_data_disk_size_gb"] = data_gb
     ck = ctx.cluster_key
     out["azure_resource_group_name"] = (
         f"${{module.{ck}.azure_resource_group_name}}"

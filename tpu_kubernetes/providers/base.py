@@ -20,6 +20,7 @@ The **cross-module output contract** (SURVEY §2.3) is encoded here once:
 from __future__ import annotations
 
 import os
+import re
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Any, Callable
@@ -118,7 +119,14 @@ def module_source(cfg: Config, module_name: str) -> str:
 # -- base configs (the provider-agnostic halves) ---------------------------
 
 def base_manager_config(ctx: BuildContext, provider: str) -> dict[str, Any]:
-    """reference: create/manager.go:16-27,156-183 (baseManagerTerraformConfig)."""
+    """reference: create/manager.go:16-27,156-183 (baseManagerTerraformConfig).
+
+    Departure (docs/design/topology.md): ``k8s_version`` and
+    ``k8s_network_provider`` are *manager*-scope here. The manager's k3s is
+    the fleet control plane, so the server version and the CNI are fleet-wide
+    facts set at manager creation — the reference scopes both per cluster
+    (create/cluster.go:349-399) because each Rancher cluster is its own k8s.
+    """
     cfg = ctx.cfg
     out: dict[str, Any] = {
         "source": module_source(cfg, f"{provider}-manager"),
@@ -128,9 +136,77 @@ def base_manager_config(ctx: BuildContext, provider: str) -> dict[str, Any]:
         ),
         "server_image": cfg.get("manager_server_image", default=""),
         "agent_image": cfg.get("manager_agent_image", default=""),
+        "k8s_version": cfg.get(
+            "k8s_version", prompt="kubernetes version (fleet control plane)",
+            choices=K8S_VERSIONS, default=K8S_VERSIONS[-1],
+        ),
+        "k8s_network_provider": cfg.get(
+            "k8s_network_provider", prompt="network provider (fleet-wide CNI)",
+            choices=NETWORK_PROVIDERS, default="calico",
+        ),
     }
+    # cilium ships no standalone manifest post-1.10 — the install script is
+    # airgap-only for it (install_manager.sh.tpl exits unless the image bakes
+    # /opt/tpu-kubernetes/manifests/cilium.yaml). Reject at render time
+    # rather than letting manager boot die halfway (policy: incoherent
+    # choices fail before apply, docs/design/topology.md).
+    if out["k8s_network_provider"] == "cilium" and not cfg.get_bool(
+        "image_has_cilium_manifest", default=False
+    ):
+        raise ProviderError(
+            "cilium requires a machine image with a baked manifest at "
+            "/opt/tpu-kubernetes/manifests/cilium.yaml (build one with "
+            "packer/ — see packer/README.md), then set "
+            "image_has_cilium_manifest: true to confirm; or choose "
+            "calico/flannel"
+        )
     _maybe_private_registry(cfg, out)
     return out
+
+
+def _minor(version: str) -> int:
+    m = re.fullmatch(r"v(\d+)\.(\d+)\.(\d+)", str(version))
+    if not m:
+        raise ProviderError(
+            f"malformed kubernetes version {version!r} (expected vMAJOR.MINOR.PATCH)"
+        )
+    return int(m.group(2))
+
+
+# kubelets may trail the API server by at most 3 minor versions
+# (kubernetes.io version-skew policy) — and may never lead it
+_KUBELET_SKEW = 3
+
+
+def _check_cluster_against_manager(
+    ctx: BuildContext, version: str, network: str
+) -> None:
+    """Render-time rejection of version/CNI choices the fleet topology cannot
+    honor (docs/design/topology.md): a cluster's workers are kubelets of the
+    manager's control plane, so their version must be within the kubelet skew
+    window, and the CNI is a fleet-wide fact fixed at manager creation."""
+    manager = ctx.state.manager() or {}
+    manager_version = manager.get("k8s_version")
+    if manager_version:
+        if _minor(version) > _minor(manager_version):
+            raise ProviderError(
+                f"cluster k8s_version {version} is newer than the manager's "
+                f"{manager_version}: kubelets cannot lead the API server "
+                "(docs/design/topology.md)"
+            )
+        if _minor(manager_version) - _minor(version) > _KUBELET_SKEW:
+            raise ProviderError(
+                f"cluster k8s_version {version} trails the manager's "
+                f"{manager_version} by more than {_KUBELET_SKEW} minor "
+                "versions (kubelet skew policy)"
+            )
+    manager_network = manager.get("k8s_network_provider")
+    if manager_network and network != manager_network:
+        raise ProviderError(
+            f"cluster network provider {network!r} differs from the fleet's "
+            f"{manager_network!r}: the CNI is fleet-wide, chosen at manager "
+            "creation (docs/design/topology.md)"
+        )
 
 
 def base_cluster_config(ctx: BuildContext, provider: str) -> dict[str, Any]:
@@ -143,17 +219,35 @@ def base_cluster_config(ctx: BuildContext, provider: str) -> dict[str, Any]:
         "api_url": f"${{module.{MANAGER_KEY}.api_url}}",
         "access_key": f"${{module.{MANAGER_KEY}.access_key}}",
         "secret_key": f"${{module.{MANAGER_KEY}.secret_key}}",
-        # reference: create/cluster.go:349-374
-        "k8s_version": cfg.get(
-            "k8s_version", prompt="kubernetes version",
-            choices=K8S_VERSIONS, default=K8S_VERSIONS[-1],
-        ),
-        # reference: create/cluster.go:377-399 (calico|flannel)
-        "k8s_network_provider": cfg.get(
-            "k8s_network_provider", prompt="network provider",
-            choices=NETWORK_PROVIDERS, default="calico",
-        ),
     }
+    manager = ctx.state.manager() or {}
+    # reference: create/cluster.go:349-374. Cluster scope = the WORKERS'
+    # kubelet version (docs/design/topology.md); defaults to the fleet's
+    # (listed first so the interactive select leads with it).
+    default_version = manager.get("k8s_version", K8S_VERSIONS[-1])
+    version_choices = [default_version] + [
+        v for v in K8S_VERSIONS if v != default_version
+    ]
+    out["k8s_version"] = cfg.get(
+        "k8s_version", prompt="kubernetes version (cluster kubelets)",
+        choices=version_choices, default=default_version,
+    )
+    # reference: create/cluster.go:377-399 (calico|flannel). Accepted at
+    # cluster scope for CLI parity, but validated == the fleet's CNI — so
+    # when the manager has recorded one there is nothing to ask: any other
+    # answer would only be rejected.
+    manager_network = manager.get("k8s_network_provider")
+    if manager_network and not cfg.is_set("k8s_network_provider"):
+        out["k8s_network_provider"] = manager_network
+    else:
+        out["k8s_network_provider"] = cfg.get(
+            "k8s_network_provider", prompt="network provider",
+            choices=NETWORK_PROVIDERS,
+            default=manager_network or "calico",
+        )
+    _check_cluster_against_manager(
+        ctx, out["k8s_version"], out["k8s_network_provider"]
+    )
     _maybe_private_registry(cfg, out)
     return out
 
@@ -174,6 +268,12 @@ def base_node_config(ctx: BuildContext, provider: str) -> dict[str, Any]:
         "registration_token": f"${{module.{ctx.cluster_key}.registration_token}}",
         "ca_checksum": f"${{module.{ctx.cluster_key}.ca_checksum}}",
         "node_role": role,
+        # version/CNI wiring (docs/design/topology.md): workers install the
+        # CLUSTER's kubelet version; control/etcd joins install the MANAGER's
+        # server version and must match its CNI backend flags
+        "k8s_version": f"${{module.{ctx.cluster_key}.k8s_version}}",
+        "server_k8s_version": f"${{module.{MANAGER_KEY}.k8s_version}}",
+        "network_provider": f"${{module.{MANAGER_KEY}.k8s_network_provider}}",
     }
     if role in ("control", "etcd"):
         # quorum joins need the k3s SERVER token (bootstrap tokens only

@@ -131,6 +131,15 @@ def build_node(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
     disk_gb = int(cfg.get("gcp_disk_size_gb", default=0) or 0)
     if disk_gb:
         out["gcp_disk_size_gb"] = disk_gb
+    # detachable data disk (reference: gcp-rancher-k8s-host/main.tf:66-73)
+    data_gb = int(cfg.get("gcp_data_disk_size_gb", default=0) or 0)
+    if data_gb:
+        out["gcp_data_disk_size_gb"] = data_gb
+    # cloud-platform scope for workload API access — GCS checkpoints
+    # (reference: gcp-rancher-k8s-host/main.tf:60-63)
+    sa = cfg.peek("gcp_service_account_email")
+    if sa:
+        out["gcp_service_account_email"] = sa
     # cluster module network handles (reference: create/node_gcp.go:63-66)
     out["gcp_compute_network_name"] = (
         f"${{module.{ctx.cluster_key}.gcp_compute_network_name}}"

@@ -70,8 +70,11 @@ def build_cluster(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
 def build_node(ctx: BuildContext, _unused: dict[str, Any]) -> dict[str, Any]:
     out = base_node_config(ctx, "gcp-tpu")
     # TPU slices always join as workers; the control-plane quorum credential
-    # must never be shipped to slice hosts
+    # must never be shipped to slice hosts — nor the server-join facts
+    # (server version / CNI flags) that only quorum joins consume
     out.pop("server_token", None)
+    out.pop("server_k8s_version", None)
+    out.pop("network_provider", None)
     _gcp_common(ctx, out)
     cfg = ctx.cfg
 
