@@ -89,7 +89,10 @@ def test_silent_install_end_to_end(cli_home, tmp_path, capsys):
     assert run([
         "--non-interactive", "--set", "cluster_manager=dev", "get", "manager",
     ]) == 0
-    assert json.loads(capsys.readouterr().out) == {}  # dry-run outputs
+    out = json.loads(capsys.readouterr().out)
+    # dry-run: no live outputs, but the persisted run report rides along
+    assert out["last_run"]["command"] == "create cluster"
+    assert set(out) == {"last_run"}
 
     # destroy in dry-run mode (no terraform) must NOT forget state —
     # the infrastructure was never actually destroyed

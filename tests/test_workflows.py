@@ -302,7 +302,9 @@ class TestGet:
             "cluster-manager": {"api_url": "https://manager.example"},
         })
         out = get.get_manager(backend, cfg, ex)
-        assert out == {"api_url": "https://manager.example"}
+        assert out["api_url"] == "https://manager.example"
+        # per-run observability rides along (SURVEY §5.1)
+        assert out["last_run"]["command"] == "create manager"
 
     def test_get_cluster_outputs(self, tmp_path):
         backend, _, _ = create_cluster(tmp_path)

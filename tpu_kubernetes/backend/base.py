@@ -57,3 +57,20 @@ class Backend(abc.ABC):
         (known TODO at backend/manta/backend.go:32); subclasses override.
         Raises :class:`LockError` if held elsewhere and not stale."""
         return contextlib.nullcontext()
+
+    # -- per-run observability (no reference analog: SURVEY §5.1 gap) ------
+    # The north-star metric is create→first-step latency; every workflow
+    # persists its phase-timing breakdown next to the state document so the
+    # latency is readable from the tool itself, not just a --timing stderr
+    # dump. Default no-ops keep minimal/mock backends working.
+
+    def persist_run_report(self, name: str, report: dict[str, Any]) -> None:
+        """Store one workflow run's timing/status report under ``name``."""
+
+    def run_reports(self, name: str) -> list[dict[str, Any]]:
+        """All stored reports for ``name``, oldest first."""
+        return []
+
+    def last_run_report(self, name: str) -> dict[str, Any] | None:
+        reports = self.run_reports(name)
+        return reports[-1] if reports else None

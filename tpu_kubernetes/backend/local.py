@@ -122,6 +122,45 @@ class LocalBackend(Backend):
         tfstate = self._dir(name) / TFSTATE_FILE
         return "terraform.backend.local", {"path": str(tfstate)}
 
+    # runs/<ns-timestamp>.json next to main.tf.json (SURVEY §5.1: the reference has
+    # no observability at all; the north-star latency must be readable here).
+    # Retention is capped so a long-lived manager doesn't accumulate forever.
+    MAX_RUN_REPORTS = 100
+
+    def persist_run_report(self, name: str, report: dict[str, Any]) -> None:
+        d = self._dir(name) / "runs"
+        d.mkdir(parents=True, exist_ok=True)
+        ts = time.time_ns()
+        tmp = d / f"{ts}.json.tmp"
+        tmp.write_bytes(json.dumps(report, indent=2, sort_keys=True).encode())
+        tmp.replace(d / f"{ts}.json")
+        stale = sorted(d.glob("*.json"))[:-self.MAX_RUN_REPORTS]
+        for p in stale:
+            p.unlink(missing_ok=True)
+
+    def run_reports(self, name: str) -> list[dict[str, Any]]:
+        d = self._dir(name) / "runs"
+        if not d.is_dir():
+            return []
+        out = []
+        for p in sorted(d.glob("*.json")):
+            try:
+                out.append(json.loads(p.read_bytes()))
+            except ValueError:
+                continue  # a torn write must not break `get manager`
+        return out
+
+    def last_run_report(self, name: str) -> dict[str, Any] | None:
+        d = self._dir(name) / "runs"
+        if not d.is_dir():
+            return None
+        for p in sorted(d.glob("*.json"), reverse=True):
+            try:
+                return json.loads(p.read_bytes())
+            except ValueError:
+                continue
+        return None
+
     @contextlib.contextmanager
     def lock(self, name: str):
         """Lockfile with O_EXCL creation; stale locks (older than

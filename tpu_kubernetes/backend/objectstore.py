@@ -222,6 +222,46 @@ class ObjectStoreBackend(Backend):
             "prefix": f"{PREFIX}/{name}",
         }
 
+    # {PREFIX}/{manager}/runs/{ns-timestamp}.json (SURVEY §5.1 gap: per-run phase
+    # timings persisted next to the document, mirroring LocalBackend).
+    # Retention is capped so a long-lived manager doesn't accumulate forever.
+    MAX_RUN_REPORTS = 100
+
+    def persist_run_report(self, name: str, report: dict[str, Any]) -> None:
+        ts = time.time_ns()
+        self.store.put(
+            self._key(name, f"runs/{ts}.json"),
+            json.dumps(report, indent=2, sort_keys=True).encode(),
+        )
+        keys = sorted(self.store.list(self._key(name, "runs/")))
+        for key in keys[:-self.MAX_RUN_REPORTS]:
+            self.store.delete(key)
+
+    def run_reports(self, name: str) -> list[dict[str, Any]]:
+        out = []
+        for key in sorted(self.store.list(self._key(name, "runs/"))):
+            data = self.store.get(key)
+            if data is None:
+                continue
+            try:
+                out.append(json.loads(data))
+            except ValueError:
+                continue
+        return out
+
+    def last_run_report(self, name: str) -> dict[str, Any] | None:
+        # one LIST + one GET, not one GET per historical report
+        for key in sorted(self.store.list(self._key(name, "runs/")),
+                          reverse=True):
+            data = self.store.get(key)
+            if data is None:
+                continue
+            try:
+                return json.loads(data)
+            except ValueError:
+                continue
+        return None
+
     # -- advisory locking (fixes reference TODO backend/manta/backend.go:32).
     # Best-effort: stale-lock breaking is not atomic (two breakers can race),
     # but each lock carries an owner id and release only deletes a lock this

@@ -20,6 +20,7 @@ from tpu_kubernetes import get as get_wf
 from tpu_kubernetes import repair as repair_wf
 from tpu_kubernetes.backend import BackendError
 from tpu_kubernetes.config import Config, ConfigError
+from tpu_kubernetes.get.kubeconfig import KubeconfigError
 from tpu_kubernetes.providers.base import ProviderError
 from tpu_kubernetes.shell import ExecutorError, ValidationError, default_executor
 from tpu_kubernetes.state import StateError
@@ -62,8 +63,10 @@ def build_parser() -> argparse.ArgumentParser:
     destroy = sub.add_parser("destroy", help="destroy a manager, cluster, or node")
     destroy.add_argument("kind", choices=["manager", "cluster", "node"])
 
-    get = sub.add_parser("get", help="query a manager or cluster")
-    get.add_argument("kind", choices=["manager", "cluster"])
+    get = sub.add_parser(
+        "get", help="query a manager or cluster, or fetch a kubeconfig"
+    )
+    get.add_argument("kind", choices=["manager", "cluster", "kubeconfig"])
 
     repair = sub.add_parser(
         "repair",
@@ -117,12 +120,16 @@ def main(argv: list[str] | None = None) -> int:
             if keys:
                 print(f"Repaired {len(keys)} module(s).")
         elif args.command == "get":
-            out = (
-                get_wf.get_manager(backend, cfg, executor)
-                if args.kind == "manager"
-                else get_wf.get_cluster(backend, cfg, executor)
-            )
-            print(json.dumps(out, indent=2, sort_keys=True))
+            if args.kind == "kubeconfig":
+                # raw YAML on stdout so `... get kubeconfig > kubeconfig` works
+                print(get_wf.get_kubeconfig(backend, cfg, executor), end="")
+            else:
+                out = (
+                    get_wf.get_manager(backend, cfg, executor)
+                    if args.kind == "manager"
+                    else get_wf.get_cluster(backend, cfg, executor)
+                )
+                print(json.dumps(out, indent=2, sort_keys=True))
     except (
         ConfigError,
         ProviderError,
@@ -132,6 +139,7 @@ def main(argv: list[str] | None = None) -> int:
         ValidationError,
         StateError,
         TopologyError,
+        KubeconfigError,
     ) as e:
         # reference prints the error then exits 1 (cmd/create.go:48-50)
         print(f"error: {e}", file=sys.stderr)

@@ -22,6 +22,7 @@ from tpu_kubernetes.providers.base import ProviderError, prompt_name
 from tpu_kubernetes.shell import Executor, validate_document
 from tpu_kubernetes.shell.outputs import inject_root_outputs
 from tpu_kubernetes.state import State
+from tpu_kubernetes.util.runlog import run_recorder
 from tpu_kubernetes.util.trace import TRACER
 
 # node-group keys that scope per-group in the YAML nodes: fan-out
@@ -31,57 +32,60 @@ _NODE_GROUP_PASSTHROUGH_DROP = ("nodes",)
 
 def new_cluster(backend: Backend, cfg: Config, executor: Executor) -> State:
     manager = select_manager(backend, cfg)
-    # lock held from the state READ through apply+persist so a concurrent CLI
-    # can't build on a stale snapshot (no reference analog — manta TODO :32)
-    with backend.lock(manager):
-        state = backend.state(manager)
+    with run_recorder(backend, manager, "create cluster") as run_info:
+        # lock held from the state READ through apply+persist so a concurrent CLI
+        # can't build on a stale snapshot (no reference analog — manta TODO :32)
+        with backend.lock(manager):
+            state = backend.state(manager)
 
-        provider_name = cfg.get(
-            "cluster_cloud_provider",
-            prompt="cloud provider for the cluster",
-            choices=cluster_providers(),
-        )
-        provider = get_provider(provider_name)
-        if provider.build_cluster is None:
-            raise ProviderError(f"provider {provider_name!r} cannot host a cluster")
+            provider_name = cfg.get(
+                "cluster_cloud_provider",
+                prompt="cloud provider for the cluster",
+                choices=cluster_providers(),
+            )
+            provider = get_provider(provider_name)
+            if provider.build_cluster is None:
+                raise ProviderError(f"provider {provider_name!r} cannot host a cluster")
 
-        name = prompt_name(cfg, "name", "cluster name", state.clusters())
+            name = prompt_name(cfg, "name", "cluster name", state.clusters())
 
-        ctx = BuildContext(cfg=cfg, state=state, name=name)
-        with TRACER.phase("build cluster config", provider=provider_name):
-            config = provider.build_cluster(ctx, {})
-        cluster_key = state.add_cluster(provider_name, name, config)
+            ctx = BuildContext(cfg=cfg, state=state, name=name)
+            with TRACER.phase("build cluster config", provider=provider_name):
+                config = provider.build_cluster(ctx, {})
+            cluster_key = state.add_cluster(provider_name, name, config)
+            run_info.update(cluster=name, provider=provider_name)
 
-        hostnames: list[str] = []
-        node_groups = cfg.peek("nodes")
-        if node_groups:
-            # silent-install fan-out (reference: create/cluster.go:165-217)
-            if not isinstance(node_groups, list):
-                raise ProviderError("'nodes' must be a list of node-group mappings")
-            for i, group in enumerate(node_groups):
-                if not isinstance(group, dict):
-                    raise ProviderError(f"nodes[{i}] must be a mapping")
-                group_cfg = _scoped_config(cfg, group)
-                hostnames += add_nodes(state, group_cfg, cluster_key)
-        elif not cfg.non_interactive:
-            # interactive add-node loop (reference: create/cluster.go:218-262);
-            # each group gets a fresh scope so answers don't bleed between groups
-            while cfg.prompter.confirm("Add a node group to this cluster?"):
-                hostnames += add_nodes(state, _scoped_config(cfg, {}, fresh=True),
-                                       cluster_key)
+            hostnames: list[str] = []
+            node_groups = cfg.peek("nodes")
+            if node_groups:
+                # silent-install fan-out (reference: create/cluster.go:165-217)
+                if not isinstance(node_groups, list):
+                    raise ProviderError("'nodes' must be a list of node-group mappings")
+                for i, group in enumerate(node_groups):
+                    if not isinstance(group, dict):
+                        raise ProviderError(f"nodes[{i}] must be a mapping")
+                    group_cfg = _scoped_config(cfg, group)
+                    hostnames += add_nodes(state, group_cfg, cluster_key)
+            elif not cfg.non_interactive:
+                # interactive add-node loop (reference: create/cluster.go:218-262);
+                # each group gets a fresh scope so answers don't bleed between groups
+                while cfg.prompter.confirm("Add a node group to this cluster?"):
+                    hostnames += add_nodes(state, _scoped_config(cfg, {}, fresh=True),
+                                           cluster_key)
 
-        if not cfg.confirm(
-            f"Create cluster {name!r} on {provider_name} with "
-            f"{len(hostnames)} node(s)?"
-        ):
-            raise ProviderError("aborted by user")
+            if not cfg.confirm(
+                f"Create cluster {name!r} on {provider_name} with "
+                f"{len(hostnames)} node(s)?"
+            ):
+                raise ProviderError("aborted by user")
 
-        validate_document(state)  # render-time contract check (SURVEY §7 #5)
-        inject_root_outputs(state)  # root forwards so `get` can read module outputs
-        backend.persist_state(state)  # persist intent before apply
-        with TRACER.phase("apply cluster", manager=manager, cluster=name):
-            executor.apply(state)
-        backend.persist_state(state)  # reference: create/cluster.go:284
+            validate_document(state)  # render-time contract check (SURVEY §7 #5)
+            inject_root_outputs(state)  # root forwards so `get` can read module outputs
+            backend.persist_state(state)  # persist intent before apply
+            run_info["nodes"] = len(hostnames)
+            with TRACER.phase("apply cluster", manager=manager, cluster=name):
+                executor.apply(state)
+            backend.persist_state(state)  # reference: create/cluster.go:284
     return state
 
 

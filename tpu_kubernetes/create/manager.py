@@ -19,6 +19,7 @@ from tpu_kubernetes.providers.base import ProviderError, prompt_name
 from tpu_kubernetes.shell import Executor, validate_document
 from tpu_kubernetes.shell.outputs import inject_root_outputs
 from tpu_kubernetes.state import State
+from tpu_kubernetes.util.runlog import run_recorder
 from tpu_kubernetes.util.trace import TRACER
 
 
@@ -39,25 +40,26 @@ def new_manager(backend: Backend, cfg: Config, executor: Executor) -> State:
     # the lock (no reference analog — manta TODO :32) is held from the state
     # READ through apply+persist, so a concurrent CLI can't build on a stale
     # snapshot and silently drop this workflow's modules on persist
-    with backend.lock(name):
-        state = backend.state(name)  # empty doc (reference: create/manager.go:103)
-        ctx = BuildContext(cfg=cfg, state=state, name=name)
-        with TRACER.phase("build manager config", provider=provider_name):
-            config = provider.build_manager(ctx, {})
-        state.set_manager(config)
+    with run_recorder(backend, name, "create manager", provider=provider_name):
+        with backend.lock(name):
+            state = backend.state(name)  # empty doc (reference: create/manager.go:103)
+            ctx = BuildContext(cfg=cfg, state=state, name=name)
+            with TRACER.phase("build manager config", provider=provider_name):
+                config = provider.build_manager(ctx, {})
+            state.set_manager(config)
 
-        # confirm (reference: create/manager.go:127-138)
-        if not cfg.confirm(f"Create cluster manager {name!r} on {provider_name}?"):
-            raise ProviderError("aborted by user")
+            # confirm (reference: create/manager.go:127-138)
+            if not cfg.confirm(f"Create cluster manager {name!r} on {provider_name}?"):
+                raise ProviderError("aborted by user")
 
-        # co-locate terraform's own state (reference: create/manager.go:140)
-        path, tf_cfg = backend.state_terraform_config(name)
-        state.set_terraform_backend_config(path, tf_cfg)
+            # co-locate terraform's own state (reference: create/manager.go:140)
+            path, tf_cfg = backend.state_terraform_config(name)
+            state.set_terraform_backend_config(path, tf_cfg)
 
-        validate_document(state)  # render-time contract check (SURVEY §7 #5)
-        inject_root_outputs(state)  # root forwards so `get` can read module outputs
-        backend.persist_state(state)  # persist intent BEFORE apply (departure)
-        with TRACER.phase("apply manager", manager=name):
-            executor.apply(state)
-        backend.persist_state(state)  # reference: create/manager.go:148
+            validate_document(state)  # render-time contract check (SURVEY §7 #5)
+            inject_root_outputs(state)  # root forwards so `get` can read module outputs
+            backend.persist_state(state)  # persist intent BEFORE apply (departure)
+            with TRACER.phase("apply manager", manager=name):
+                executor.apply(state)
+            backend.persist_state(state)  # reference: create/manager.go:148
     return state

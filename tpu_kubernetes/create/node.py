@@ -22,6 +22,7 @@ from tpu_kubernetes.shell import Executor, validate_document
 from tpu_kubernetes.shell.outputs import inject_root_outputs
 from tpu_kubernetes.state import State, cluster_key_parts
 from tpu_kubernetes.util import new_hostnames, validate_name
+from tpu_kubernetes.util.runlog import run_recorder
 from tpu_kubernetes.util.trace import TRACER
 
 
@@ -109,22 +110,24 @@ def add_nodes(state: State, cfg: Config, cluster_key: str) -> list[str]:
 def new_node(backend: Backend, cfg: Config, executor: Executor) -> list[str]:
     """Full ``create node`` flow (reference: create/node.go:43-163)."""
     manager = select_manager(backend, cfg)
-    # lock held from the state READ through apply+persist so a concurrent CLI
-    # can't build on a stale snapshot (no reference analog — manta TODO :32)
-    with backend.lock(manager):
-        state = backend.state(manager)
-        cluster_key = select_cluster(state, cfg)
-        hostnames = add_nodes(state, cfg, cluster_key)
+    with run_recorder(backend, manager, "create node") as run_info:
+        # lock held from the state READ through apply+persist so a concurrent CLI
+        # can't build on a stale snapshot (no reference analog — manta TODO :32)
+        with backend.lock(manager):
+            state = backend.state(manager)
+            cluster_key = select_cluster(state, cfg)
+            hostnames = add_nodes(state, cfg, cluster_key)
+            run_info.update(cluster=cluster_key, nodes=len(hostnames))
 
-        if not cfg.confirm(
-            f"Add {len(hostnames)} node(s) {hostnames} to {cluster_key}?"
-        ):
-            raise ProviderError("aborted by user")
+            if not cfg.confirm(
+                f"Add {len(hostnames)} node(s) {hostnames} to {cluster_key}?"
+            ):
+                raise ProviderError("aborted by user")
 
-        validate_document(state)  # render-time contract check (SURVEY §7 #5)
-        inject_root_outputs(state)  # root forwards so `get` can read module outputs
-        backend.persist_state(state)  # persist intent before apply
-        with TRACER.phase("apply nodes", manager=manager, count=len(hostnames)):
-            executor.apply(state)
-        backend.persist_state(state)
+            validate_document(state)  # render-time contract check (SURVEY §7 #5)
+            inject_root_outputs(state)  # root forwards so `get` can read module outputs
+            backend.persist_state(state)  # persist intent before apply
+            with TRACER.phase("apply nodes", manager=manager, count=len(hostnames)):
+                executor.apply(state)
+            backend.persist_state(state)
     return hostnames

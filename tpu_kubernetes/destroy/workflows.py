@@ -15,6 +15,7 @@ from tpu_kubernetes.providers.base import ProviderError
 from tpu_kubernetes.shell import Executor
 from tpu_kubernetes.shell.executor import dry_run_skip
 from tpu_kubernetes.shell.outputs import inject_root_outputs
+from tpu_kubernetes.util.runlog import run_recorder
 from tpu_kubernetes.util.trace import TRACER
 
 
@@ -46,52 +47,56 @@ def delete_cluster(backend: Backend, cfg: Config, executor: Executor) -> None:
     """Targeted destroy of one cluster + its nodes.
     reference: destroy/cluster.go:16-161."""
     manager = select_manager(backend, cfg)
-    # lock held from the state READ through destroy+persist (see create/)
-    with backend.lock(manager):
-        state = backend.state(manager)
-        cluster_key = select_cluster(state, cfg)
-        node_keys = sorted(state.nodes(cluster_key).values())
+    with run_recorder(backend, manager, "destroy cluster") as run_info:
+        # lock held from the state READ through destroy+persist (see create/)
+        with backend.lock(manager):
+            state = backend.state(manager)
+            cluster_key = select_cluster(state, cfg)
+            node_keys = sorted(state.nodes(cluster_key).values())
+            run_info["cluster"] = cluster_key
 
-        if not cfg.confirm(
-            f"Destroy cluster {cluster_key} and its {len(node_keys)} node(s)?"
-        ):
-            raise ProviderError("aborted by user")
+            if not cfg.confirm(
+                f"Destroy cluster {cluster_key} and its {len(node_keys)} node(s)?"
+            ):
+                raise ProviderError("aborted by user")
 
-        # targets: the cluster module + one per node module
-        # (reference: destroy/cluster.go:126-138)
-        targets = [f"module.{cluster_key}"] + [f"module.{k}" for k in node_keys]
-        with TRACER.phase("destroy cluster", manager=manager, cluster=cluster_key):
-            executor.destroy(state, targets=targets)
-        if _destroy_skipped(executor, f"cluster {cluster_key}"):
-            return
+            # targets: the cluster module + one per node module
+            # (reference: destroy/cluster.go:126-138)
+            targets = [f"module.{cluster_key}"] + [f"module.{k}" for k in node_keys]
+            with TRACER.phase("destroy cluster", manager=manager, cluster=cluster_key):
+                executor.destroy(state, targets=targets)
+            if _destroy_skipped(executor, f"cluster {cluster_key}"):
+                return
 
-        # remove from the document (reference: destroy/cluster.go:147-158)
-        for key in [cluster_key, *node_keys]:
-            state.delete_module(key)
-        inject_root_outputs(state)  # drop forwards of deleted modules
-        backend.persist_state(state)
+            # remove from the document (reference: destroy/cluster.go:147-158)
+            for key in [cluster_key, *node_keys]:
+                state.delete_module(key)
+            inject_root_outputs(state)  # drop forwards of deleted modules
+            backend.persist_state(state)
 
 
 def delete_node(backend: Backend, cfg: Config, executor: Executor) -> None:
     """Targeted destroy of one node module. reference: destroy/node.go:16-180."""
     manager = select_manager(backend, cfg)
-    # lock held from the state READ through destroy+persist (see create/)
-    with backend.lock(manager):
-        state = backend.state(manager)
-        cluster_key = select_cluster(state, cfg)
-        nodes = state.nodes(cluster_key)
-        if not nodes:
-            raise ProviderError(f"cluster {cluster_key} has no nodes")
-        hostname = cfg.get("hostname", prompt="node to destroy", choices=sorted(nodes))
-        node_key = nodes[hostname]
+    with run_recorder(backend, manager, "destroy node") as run_info:
+        # lock held from the state READ through destroy+persist (see create/)
+        with backend.lock(manager):
+            state = backend.state(manager)
+            cluster_key = select_cluster(state, cfg)
+            nodes = state.nodes(cluster_key)
+            if not nodes:
+                raise ProviderError(f"cluster {cluster_key} has no nodes")
+            hostname = cfg.get("hostname", prompt="node to destroy", choices=sorted(nodes))
+            node_key = nodes[hostname]
+            run_info["node"] = node_key
 
-        if not cfg.confirm(f"Destroy node {node_key}?"):
-            raise ProviderError("aborted by user")
+            if not cfg.confirm(f"Destroy node {node_key}?"):
+                raise ProviderError("aborted by user")
 
-        with TRACER.phase("destroy node", manager=manager, node=node_key):
-            executor.destroy(state, targets=[f"module.{node_key}"])
-        if _destroy_skipped(executor, f"node {node_key}"):
-            return
-        state.delete_module(node_key)
-        inject_root_outputs(state)  # drop forwards of deleted modules
-        backend.persist_state(state)
+            with TRACER.phase("destroy node", manager=manager, node=node_key):
+                executor.destroy(state, targets=[f"module.{node_key}"])
+            if _destroy_skipped(executor, f"node {node_key}"):
+                return
+            state.delete_module(node_key)
+            inject_root_outputs(state)  # drop forwards of deleted modules
+            backend.persist_state(state)

@@ -1,1 +1,5 @@
-from tpu_kubernetes.get.workflows import get_cluster, get_manager  # noqa: F401
+from tpu_kubernetes.get.workflows import (  # noqa: F401
+    get_cluster,
+    get_kubeconfig,
+    get_manager,
+)

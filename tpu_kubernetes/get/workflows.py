@@ -17,10 +17,15 @@ from tpu_kubernetes.state import MANAGER_KEY
 
 
 def get_manager(backend: Backend, cfg: Config, executor: Executor) -> dict[str, Any]:
-    """reference: get/manager.go:83-92."""
+    """reference: get/manager.go:83-92 — plus the latest run report (phase
+    timing breakdown, SURVEY §5.1) which the reference has no analog for."""
     manager = select_manager(backend, cfg)
     state = backend.state(manager)
-    return executor.output(state, MANAGER_KEY)
+    out = executor.output(state, MANAGER_KEY)
+    last_run = backend.last_run_report(manager)
+    if last_run is not None:
+        out = {**out, "last_run": last_run}
+    return out
 
 
 def get_cluster(backend: Backend, cfg: Config, executor: Executor) -> dict[str, Any]:
@@ -29,3 +34,28 @@ def get_cluster(backend: Backend, cfg: Config, executor: Executor) -> dict[str, 
     state = backend.state(manager)
     cluster_key = select_cluster(state, cfg)
     return executor.output(state, cluster_key)
+
+
+def get_kubeconfig(backend: Backend, cfg: Config, executor: Executor) -> str:
+    """Synthesize a working kubeconfig from the manager's live outputs —
+    the aha-flow closer (see tpu_kubernetes/get/kubeconfig.py; reference
+    analog: setup_rancher.sh.tpl:1-50 minting usable API credentials)."""
+    from tpu_kubernetes.get.kubeconfig import (
+        KubeconfigError,
+        build_kubeconfig,
+        fetch_ca_pem,
+    )
+
+    manager = select_manager(backend, cfg)
+    state = backend.state(manager)
+    outputs = executor.output(state, MANAGER_KEY)
+    api_url = outputs.get("api_url")
+    token = outputs.get("secret_key")
+    if not api_url or not token:
+        raise KubeconfigError(
+            f"manager {manager!r} has no live api_url/secret_key outputs — "
+            "has it been applied with terraform installed? (dry-run state "
+            "documents carry no outputs)"
+        )
+    ca_pem = fetch_ca_pem(str(api_url))
+    return build_kubeconfig(manager, str(api_url), str(token), ca_pem)

@@ -1,0 +1,60 @@
+"""Persist one workflow run's phase timings to the state backend.
+
+The reference has no observability beyond stdout (SURVEY §5.1/§5.5), yet the
+north-star metric is create→first-train-step latency — so every workflow
+records its phase breakdown (render/validate/apply/…) as a run report next
+to the state document (``runs/<millis>.json``), where ``get manager``
+surfaces the latest one.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any
+
+from tpu_kubernetes.util.trace import TRACER
+
+
+def record_run(
+    backend: Any,
+    manager: str,
+    command: str,
+    since: int,
+    status: str = "ok",
+    **extra: Any,
+) -> None:
+    """Write a run report; never let observability break the workflow."""
+    phases = TRACER.report(since=since)
+    report = {
+        "command": command,
+        "manager": manager,
+        "status": status,
+        "finished_at": time.time(),
+        "total_seconds": round(sum(p["seconds"] for p in phases), 3),
+        "phases": phases,
+        **extra,
+    }
+    try:
+        backend.persist_run_report(manager, report)
+    except Exception as e:  # noqa: BLE001 — observability must not fail a run
+        import sys
+
+        print(f"[tpu-k8s] WARNING: could not persist run report: {e}",
+              file=sys.stderr)
+
+
+@contextlib.contextmanager
+def run_recorder(backend: Any, manager: str, command: str, **extra: Any):
+    """Record the run whichever way it ends: failed runs are exactly the
+    ones worth inspecting in ``get manager``, so an exception records
+    ``status: error`` (with the phases that did complete) and re-raises.
+    Yields a dict the workflow may add extras to (cluster=…, nodes=…)."""
+    mark = TRACER.mark()
+    info = dict(extra)
+    try:
+        yield info
+    except BaseException:
+        record_run(backend, manager, command, mark, status="error", **info)
+        raise
+    record_run(backend, manager, command, mark, **info)

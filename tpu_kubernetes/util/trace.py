@@ -46,10 +46,16 @@ class Tracer:
             if self.enabled:
                 print(f"[tpu-k8s] ✓ {name} ({span.seconds:.1f}s)", file=self.stream)
 
-    def report(self) -> list[dict]:
+    def mark(self) -> int:
+        """Current span count — pass to :meth:`report` to scope one run's
+        spans when several workflows share a process (tests, silent-install
+        fan-out)."""
+        return len(self.spans)
+
+    def report(self, since: int = 0) -> list[dict]:
         return [
             {"phase": s.name, "seconds": round(s.seconds, 3), **s.meta}
-            for s in self.spans
+            for s in self.spans[since:]
         ]
 
     def dump_json(self) -> str:
