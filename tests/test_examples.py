@@ -62,3 +62,62 @@ def test_tpu_cluster_examples(cli_home):
     node = doc["module"][dev_nodes[0]]
     assert node["tpu_accelerator_type"] == "v5litepod-4"
     assert node["tpu_hosts"] == 1
+
+
+def test_hybrid_aws_plus_tpu_example(cli_home):
+    """BASELINE config #4: one manager, an AWS GPU pool AND a gcp-tpu pool in
+    the same state document (reference multi-provider state model:
+    state/state.go:55-77). Asserts both module sets, both providers'
+    catalogs/config paths, and the cross-module output contracts."""
+    tk_home, creds = cli_home
+    assert main([
+        "--config", f"{EXAMPLES}/hybrid-manager.yaml", "--non-interactive",
+        "create", "manager",
+    ]) == 0
+    assert main([
+        "--config", f"{EXAMPLES}/hybrid-aws-cluster.yaml", "--non-interactive",
+        "create", "cluster",
+    ]) == 0
+    assert main([
+        "--config", f"{EXAMPLES}/hybrid-tpu-cluster.yaml", "--non-interactive",
+        "--set", f"gcp_path_to_credentials={creds}",
+        "create", "cluster",
+    ]) == 0
+
+    doc = json.loads((tk_home / "hybrid" / "main.tf.json").read_text())
+    m = doc["module"]
+
+    # one manager, two clusters on different clouds, all in one document
+    assert m["cluster-manager"]["source"].endswith("aws-manager")
+    assert m["cluster_aws_gpu-pool"]["source"].endswith("aws-cluster")
+    assert m["cluster_gcp-tpu_tpu-pool"]["source"].endswith("gcp-tpu-cluster")
+
+    # AWS pool: 2 GPU workers with EBS data disks
+    gpu_nodes = [k for k in m if k.startswith("node_aws_gpu-pool_")]
+    assert len(gpu_nodes) == 2
+    assert m[gpu_nodes[0]]["aws_instance_type"] == "p4d.24xlarge"
+    assert m[gpu_nodes[0]]["aws_ebs_volume_size_gb"] == 500
+
+    # TPU pool: 2 × v5p-32 slices (4 hosts each), workers only
+    tpu_nodes = [k for k in m if k.startswith("node_gcp-tpu_tpu-pool_")]
+    assert len(tpu_nodes) == 2
+    assert m[tpu_nodes[0]]["tpu_accelerator_type"] == "v5p-32"
+    assert m[tpu_nodes[0]]["tpu_hosts"] == 4
+    assert "server_token" not in m[tpu_nodes[0]]
+
+    # cross-module contracts: every cluster consumes the one manager's
+    # outputs; every node consumes its OWN cluster's outputs
+    for ck in ("cluster_aws_gpu-pool", "cluster_gcp-tpu_tpu-pool"):
+        assert m[ck]["api_url"] == "${module.cluster-manager.api_url}"
+    assert m[gpu_nodes[0]]["registration_token"] == (
+        "${module.cluster_aws_gpu-pool.registration_token}"
+    )
+    assert m[tpu_nodes[0]]["registration_token"] == (
+        "${module.cluster_gcp-tpu_tpu-pool.registration_token}"
+    )
+    # both pools' kubelets inherit the fleet version through their cluster
+    assert m[gpu_nodes[0]]["k8s_version"] == (
+        "${module.cluster_aws_gpu-pool.k8s_version}"
+    )
+    assert m["cluster_aws_gpu-pool"]["k8s_version"] == "v1.31.1"
+    assert m["cluster_gcp-tpu_tpu-pool"]["k8s_version"] == "v1.31.1"
