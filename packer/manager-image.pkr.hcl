@@ -1,0 +1,78 @@
+# Manager image: pre-bake the fleet control plane's boot path.
+#
+# Reference analog: packer/rancher-server.yaml — the reference pre-pulls
+# rancher/server:v1.6.14 into a dedicated server image
+# (packer/packer-config:41-103) so manager boot skips the docker pull. Our
+# manager is a k3s server (install_manager.sh.tpl); baking the k3s binary,
+# its airgap images, and the CNI/JobSet manifests removes every network
+# fetch from the boot path — which is where create→first-train-step minutes
+# go (install_manager steps 1/3/5).
+
+packer {
+  required_plugins {
+    googlecompute = {
+      version = ">= 1.1"
+      source  = "github.com/hashicorp/googlecompute"
+    }
+  }
+}
+
+variable "project_id" {
+  type = string
+}
+
+variable "zone" {
+  type    = string
+  default = "us-central1-a"
+}
+
+variable "source_image_family" {
+  type    = string
+  default = "ubuntu-2204-lts"
+}
+
+variable "source_image_project_id" {
+  type    = string
+  default = "ubuntu-os-cloud"
+}
+
+variable "cilium_manifest_url" {
+  # cilium ships no standalone manifest post-1.10: render one with
+  # `helm template cilium cilium/cilium`, host it (GCS/HTTP), and pass its
+  # URL here; confirm with image_has_cilium_manifest: true at manager
+  # creation. Empty = image supports calico/flannel only.
+  type    = string
+  default = ""
+}
+
+variable "k8s_version" {
+  # must match the fleet k8s_version the manager will be created with
+  # (docs/design/topology.md); the boot script's pinned install detects the
+  # preinstalled binary and skips the download when versions agree
+  type    = string
+  default = "v1.31.1"
+}
+
+source "googlecompute" "manager" {
+  project_id              = var.project_id
+  zone                    = var.zone
+  source_image_family     = var.source_image_family
+  source_image_project_id = [var.source_image_project_id]
+  image_name              = "tpu-kubernetes-manager-{{timestamp}}"
+  image_family            = "tpu-kubernetes-manager"
+  machine_type            = "n2-standard-4"
+  disk_size               = 50
+  ssh_username            = "packer"
+}
+
+build {
+  sources = ["source.googlecompute.manager"]
+
+  provisioner "shell" {
+    script           = "${path.root}/scripts/bake_manager.sh"
+    environment_vars = [
+      "K8S_VERSION=${var.k8s_version}",
+      "CILIUM_MANIFEST_URL=${var.cilium_manifest_url}",
+    ]
+  }
+}

@@ -1,0 +1,43 @@
+#!/bin/sh
+# Bake the manager image (run by packer inside the build VM).
+#
+# Everything here is something install_manager.sh.tpl would otherwise fetch
+# at boot (reference analog: the rancher-server pre-pull,
+# packer/packer-config:41-103):
+#   1. the k3s binary + airgap images, pinned to the fleet k8s version
+#   2. the CNI manifests (calico; cilium if a manifest is provided at
+#      build time) and the JobSet controller manifest, under
+#      /opt/tpu-kubernetes/manifests — the airgap-first paths the boot
+#      script applies (install_manager.sh.tpl steps 3+5)
+set -eu
+
+K8S_VERSION="${K8S_VERSION:-v1.31.1}"
+K3S_RELEASE="${K8S_VERSION}+k3s1"
+MANIFESTS=/opt/tpu-kubernetes/manifests
+
+export DEBIAN_FRONTEND=noninteractive
+
+# 1. k3s binary + airgap images, pinned (URL-encode the '+' in the tag)
+tag=$(printf '%s' "$K3S_RELEASE" | sed 's/+/%2B/')
+curl -sfL -o /usr/local/bin/k3s \
+  "https://github.com/k3s-io/k3s/releases/download/$tag/k3s"
+chmod +x /usr/local/bin/k3s
+mkdir -p /var/lib/rancher/k3s/agent/images
+curl -sfL -o /var/lib/rancher/k3s/agent/images/k3s-airgap-images-amd64.tar.zst \
+  "https://github.com/k3s-io/k3s/releases/download/$tag/k3s-airgap-images-amd64.tar.zst"
+
+# 2. manifests the boot path applies airgap-first
+mkdir -p "$MANIFESTS"
+curl -sfL -o "$MANIFESTS/calico.yaml" \
+  "https://raw.githubusercontent.com/projectcalico/calico/v3.28.1/manifests/calico.yaml"
+curl -sfL -o "$MANIFESTS/jobset.yaml" \
+  "https://github.com/kubernetes-sigs/jobset/releases/download/v0.8.0/manifests.yaml"
+# cilium ships no standalone manifest post-1.10; pass a rendered one (e.g.
+# `helm template cilium cilium/cilium`, hosted on GCS/HTTP) via
+# -var cilium_manifest_url=... at build time
+CILIUM_MANIFEST_URL="${CILIUM_MANIFEST_URL:-}"
+if [ -n "$CILIUM_MANIFEST_URL" ]; then
+  curl -sfL -o "$MANIFESTS/cilium.yaml" "$CILIUM_MANIFEST_URL"
+fi
+
+echo "manager bake complete (k3s $K3S_RELEASE)"

@@ -16,14 +16,17 @@ pip install --no-cache-dir -U "jax[tpu]" flax optax orbax-checkpoint einops \
 # 2. the framework's training stack
 pip install --no-cache-dir tpu-kubernetes[tpu]
 
-# 3. k3s binary + airgap images (no curl|sh at boot; the boot script detects
-#    the preinstalled binary and skips the download)
+# 3. k3s binary + airgap images, PINNED to the fleet k8s version so the
+#    boot script's version check matches and skips the download
+#    (install_tpu_agent.sh.tpl sets INSTALL_K3S_SKIP_DOWNLOAD on match)
+K8S_VERSION="${K8S_VERSION:-v1.31.1}"
+tag=$(printf '%s' "$K8S_VERSION+k3s1" | sed 's/+/%2B/')
 curl -sfL -o /usr/local/bin/k3s \
-  "https://github.com/k3s-io/k3s/releases/latest/download/k3s"
+  "https://github.com/k3s-io/k3s/releases/download/$tag/k3s"
 chmod +x /usr/local/bin/k3s
 mkdir -p /var/lib/rancher/k3s/agent/images
 curl -sfL -o /var/lib/rancher/k3s/agent/images/k3s-airgap-images-amd64.tar.zst \
-  "https://github.com/k3s-io/k3s/releases/latest/download/k3s-airgap-images-amd64.tar.zst"
+  "https://github.com/k3s-io/k3s/releases/download/$tag/k3s-airgap-images-amd64.tar.zst"
 
 # 4. warm the XLA compile cache for the flagship shapes so the first real
 #    train step skips most of compilation

@@ -31,6 +31,13 @@ variable "source_image_family" {
   default = "tpu-ubuntu2204-base" # TPU-VM base: libtpu + drivers preinstalled
 }
 
+variable "k8s_version" {
+  # pin the baked k3s to the fleet k8s version so the boot script's
+  # version match skips the download (docs/design/topology.md)
+  type    = string
+  default = "v1.31.1"
+}
+
 source "googlecompute" "tpu_vm" {
   project_id          = var.project_id
   zone                = var.zone
@@ -46,6 +53,9 @@ build {
   sources = ["source.googlecompute.tpu_vm"]
 
   provisioner "shell" {
-    script = "${path.root}/scripts/bake_tpu_agent.sh"
+    script           = "${path.root}/scripts/bake_tpu_agent.sh"
+    environment_vars = [
+      "K8S_VERSION=${var.k8s_version}",
+    ]
   }
 }

@@ -60,9 +60,15 @@ case "$NETWORK_PROVIDER" in
 esac
 
 # 1. install k3s server, pinned to the configured kubernetes version
-#    (v1.31.1 → k3s release v1.31.1+k3s1)
-if ! command -v k3s >/dev/null 2>&1; then
-  curl -sfL https://get.k3s.io | INSTALL_K3S_VERSION="$K8S_VERSION+k3s1" sh -s - server \
+#    (v1.31.1 → k3s release v1.31.1+k3s1). The installer always runs (it
+#    creates the systemd service); the DOWNLOAD is skipped when a baked
+#    image (packer/) already carries the matching binary.
+export INSTALL_K3S_VERSION="$K8S_VERSION+k3s1"
+if command -v k3s >/dev/null 2>&1 && k3s --version 2>/dev/null | grep -qF "$INSTALL_K3S_VERSION"; then
+  export INSTALL_K3S_SKIP_DOWNLOAD=true
+fi
+if [ ! -f /etc/systemd/system/k3s.service ]; then
+  curl -sfL https://get.k3s.io | sh -s - server \
     --cluster-init \
     --node-label tpu-kubernetes/role=manager \
     $cni_flags

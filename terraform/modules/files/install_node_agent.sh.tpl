@@ -122,6 +122,14 @@ case "$NETWORK_PROVIDER" in
   calico|cilium) cni_flags="--flannel-backend=none --disable-network-policy" ;;
 esac
 
+# skip the k3s DOWNLOAD (not the installer — it creates the service) when a
+# baked image already carries the right binary
+skip_download_if_baked() { # $1 = wanted k3s release
+  if command -v k3s >/dev/null 2>&1 && k3s --version 2>/dev/null | grep -qF "$1"; then
+    export INSTALL_K3S_SKIP_DOWNLOAD=true
+  fi
+}
+
 case "$ROLE" in
   control|etcd)
     # reference maps control→controlplane (gcp-rancher-k8s-host/main.tf:22);
@@ -132,11 +140,15 @@ case "$ROLE" in
       echo "role $ROLE requires a server token but none was provided" >&2
       exit 1
     fi
-    curl -sfL https://get.k3s.io | INSTALL_K3S_VERSION="$SERVER_K8S_VERSION+k3s1" sh -s - server \
+    export INSTALL_K3S_VERSION="$SERVER_K8S_VERSION+k3s1"
+    skip_download_if_baked "$INSTALL_K3S_VERSION"
+    curl -sfL https://get.k3s.io | sh -s - server \
       --server "$API_URL" --token "$SERVER_TOKEN" $labels $cni_flags
     ;;
   worker)
-    curl -sfL https://get.k3s.io | INSTALL_K3S_VERSION="$K8S_VERSION+k3s1" sh -s - agent \
+    export INSTALL_K3S_VERSION="$K8S_VERSION+k3s1"
+    skip_download_if_baked "$INSTALL_K3S_VERSION"
+    curl -sfL https://get.k3s.io | sh -s - agent \
       --server "$API_URL" --token "$TOKEN" $labels
     ;;
   *)

@@ -80,7 +80,13 @@ actual=$(curl -ks "$API_URL/cacerts" | sha256sum | cut -d' ' -f1)
 if [ -n "$CA_CHECKSUM" ] && [ "$actual" != "$CA_CHECKSUM" ]; then
   echo "CA checksum mismatch" >&2; exit 1
 fi
-curl -sfL https://get.k3s.io | INSTALL_K3S_VERSION="$K8S_VERSION+k3s1" sh -s - agent \
+export INSTALL_K3S_VERSION="$K8S_VERSION+k3s1"
+if command -v k3s >/dev/null 2>&1 && k3s --version 2>/dev/null | grep -qF "$INSTALL_K3S_VERSION"; then
+  # baked image (packer/) already carries the binary — skip the download,
+  # still run the installer (it creates the systemd service)
+  export INSTALL_K3S_SKIP_DOWNLOAD=true
+fi
+curl -sfL https://get.k3s.io | sh -s - agent \
   --server "$API_URL" --token "$TOKEN" \
   --node-label tpu-kubernetes/role=worker \
   --node-label tpu-kubernetes/accelerator="$ACCELERATOR_TYPE" \
