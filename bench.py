@@ -241,9 +241,7 @@ def measure_decode(model_name: str, batch: int, prompt_len: int,
     import jax
 
     from tpu_kubernetes.models import CONFIGS, init_params
-    from tpu_kubernetes.models.decode import generate
-
-    from tpu_kubernetes.models.decode import prefill
+    from tpu_kubernetes.models.decode import generate, prefill
 
     cfg = CONFIGS[model_name]
     reps = 3
@@ -278,7 +276,15 @@ def measure_decode(model_name: str, batch: int, prompt_len: int,
         jax.block_until_ready(logits)
         prefill_time = (time.perf_counter() - t0) / reps
 
-    decode_time = max(per_call - prefill_time, 1e-9)
+    decode_time = per_call - prefill_time
+    if decode_time <= 0.1 * per_call:
+        # prefill dominates (tiny max_new or timing noise): a subtracted
+        # figure would be fabricated — degrade to the section's in-band
+        # error rather than report garbage tokens/s
+        raise RuntimeError(
+            f"decode time not measurable: per_call={per_call*1e3:.1f}ms "
+            f"prefill={prefill_time*1e3:.1f}ms — raise BENCH_DECODE_NEW"
+        )
     tokens_per_sec = batch * max_new / decode_time
     per_token_ms = decode_time / max_new * 1e3
     log(f"decode: tokens/s={tokens_per_sec:.0f} step={per_token_ms:.2f}ms "

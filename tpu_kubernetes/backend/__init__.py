@@ -7,3 +7,8 @@ from tpu_kubernetes.backend.objectstore import (  # noqa: F401
     ObjectStoreBackend,
     new_gcs_backend,
 )
+from tpu_kubernetes.backend.s3 import (  # noqa: F401
+    S3Backend,
+    S3Store,
+    new_s3_backend,
+)

@@ -1,0 +1,283 @@
+"""S3 object store for the state backend (the reference's Manta parity,
+rounded out: SURVEY §7 phase 6 asks for "Manta→GCS/S3 backend parity" —
+GCS landed in round 1, this is the S3 side).
+
+No boto3 in the runtime: requests are signed with a self-contained AWS
+Signature V4 implementation over stdlib ``hashlib``/``hmac``/``urllib`` —
+the same stance as the Triton CloudAPI http-signature client
+(catalog/triton.py). Works against AWS S3 and S3-compatible stores via
+``endpoint`` (MinIO, Ceph RGW, GCS's S3 interop mode).
+
+Reference analog: backend/manta/backend.go:50-95 builds an SSH-key-signed
+Manta client by hand rather than pulling a heavyweight SDK; same spirit.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+
+from tpu_kubernetes.backend.base import BackendError
+from tpu_kubernetes.backend.objectstore import ObjectStore, ObjectStoreBackend
+
+_ALGO = "AWS4-HMAC-SHA256"
+_EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def signing_key(secret_key: str, date: str, region: str, service: str = "s3") -> bytes:
+    """The SigV4 key-derivation chain (AWS docs: "Deriving the signing key")."""
+    k = _hmac(("AWS4" + secret_key).encode(), date)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    return _hmac(k, "aws4_request")
+
+
+def canonical_query(params: dict[str, str]) -> str:
+    """Sorted, URI-encoded query string per the SigV4 canonical form."""
+    return "&".join(
+        f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+        for k, v in sorted(params.items())
+    )
+
+
+def sign_request(
+    method: str,
+    host: str,
+    path: str,
+    query: dict[str, str],
+    headers: dict[str, str],
+    payload: bytes,
+    *,
+    access_key: str,
+    secret_key: str,
+    region: str,
+    now: datetime.datetime | None = None,
+) -> dict[str, str]:
+    """Return ``headers`` plus ``x-amz-*`` and ``Authorization`` for one
+    request. Pure function of its inputs (``now`` injectable) so the test
+    suite can pin it to the official AWS SigV4 vectors."""
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    date = now.strftime("%Y%m%d")
+    payload_hash = hashlib.sha256(payload).hexdigest() if payload else _EMPTY_SHA256
+
+    all_headers = {
+        **{k.lower(): v.strip() for k, v in headers.items()},
+        "host": host,
+        "x-amz-content-sha256": payload_hash,
+        "x-amz-date": amz_date,
+    }
+    signed_names = ";".join(sorted(all_headers))
+    canonical_headers = "".join(
+        f"{k}:{all_headers[k]}\n" for k in sorted(all_headers)
+    )
+    canonical = "\n".join([
+        method,
+        urllib.parse.quote(path, safe="/"),
+        canonical_query(query),
+        canonical_headers,
+        signed_names,
+        payload_hash,
+    ])
+    scope = f"{date}/{region}/s3/aws4_request"
+    string_to_sign = "\n".join([
+        _ALGO, amz_date, scope,
+        hashlib.sha256(canonical.encode()).hexdigest(),
+    ])
+    signature = hmac.new(
+        signing_key(secret_key, date, region),
+        string_to_sign.encode(), hashlib.sha256,
+    ).hexdigest()
+    authorization = (
+        f"{_ALGO} Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed_names}, Signature={signature}"
+    )
+    out = dict(headers)
+    out.update({
+        "x-amz-content-sha256": payload_hash,
+        "x-amz-date": amz_date,
+        "Authorization": authorization,
+    })
+    return out
+
+
+class S3Store(ObjectStore):
+    """Minimal S3 REST client: GET/PUT/DELETE object + ListObjectsV2.
+
+    ``put_if_absent`` uses S3 conditional writes (``If-None-Match: *``;
+    412 = already exists). Stores that predate conditional writes answer
+    501 — surfaced as a BackendError naming the limitation rather than
+    silently downgrading the locking guarantee (the reference's Manta
+    backend has exactly that unguarded gap, backend/manta/backend.go:32).
+    """
+
+    def __init__(
+        self,
+        bucket: str,
+        *,
+        access_key: str,
+        secret_key: str,
+        region: str = "us-east-1",
+        endpoint: str = "",
+        timeout_s: float = 30.0,
+    ):
+        self.bucket = bucket
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        # path-style addressing: one code path for AWS and S3-compatibles
+        self.base = (endpoint.rstrip("/") if endpoint
+                     else f"https://s3.{region}.amazonaws.com")
+        self.timeout_s = timeout_s
+
+    def _request(
+        self,
+        method: str,
+        key: str = "",
+        query: dict[str, str] | None = None,
+        payload: bytes = b"",
+        headers: dict[str, str] | None = None,
+        ok: tuple[int, ...] = (200,),
+    ) -> tuple[int, bytes]:
+        query = query or {}
+        parsed = urllib.parse.urlparse(self.base)
+        # include any endpoint path prefix (reverse-proxied S3-compatibles,
+        # e.g. https://proxy/minio) in the SIGNED path — signing only the
+        # bucket path would 403 with SignatureDoesNotMatch on every call
+        base_path = parsed.path.rstrip("/")
+        path = f"{base_path}/{self.bucket}" + (f"/{key}" if key else "")
+        signed = sign_request(
+            method, parsed.netloc, path, query, headers or {}, payload,
+            access_key=self.access_key, secret_key=self.secret_key,
+            region=self.region,
+        )
+        url = (f"{parsed.scheme}://{parsed.netloc}"
+               + urllib.parse.quote(path, safe="/"))
+        if query:
+            url += "?" + canonical_query(query)
+        req = urllib.request.Request(url, data=payload or None, method=method)
+        for k, v in signed.items():
+            req.add_header(k, v)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            if e.code in ok:
+                return e.code, body
+            raise BackendError(
+                f"S3 {method} {path}: HTTP {e.code} "
+                f"{body[:200].decode(errors='replace')}"
+            ) from e
+        except urllib.error.URLError as e:
+            raise BackendError(f"S3 {method} {path}: {e}") from e
+
+    def get(self, key: str) -> bytes | None:
+        status, body = self._request("GET", key, ok=(200, 404))
+        return None if status == 404 else body
+
+    def put(self, key: str, data: bytes) -> None:
+        self._request("PUT", key, payload=data)
+
+    def put_if_absent(self, key: str, data: bytes) -> bool:
+        # 412 = key exists; 409 = ConditionalRequestConflict, AWS's answer
+        # to SIMULTANEOUS If-None-Match writes — the loser of a lock race,
+        # i.e. contention, not an infrastructure error
+        status, _ = self._request(
+            "PUT", key, payload=data,
+            headers={"If-None-Match": "*"}, ok=(200, 409, 412, 501),
+        )
+        if status == 501:
+            raise BackendError(
+                "this S3 endpoint does not support conditional writes "
+                "(If-None-Match) — state locking cannot be guaranteed; use "
+                "the gcs or local backend, or an S3 service with "
+                "conditional-write support"
+            )
+        return status == 200
+
+    def delete(self, key: str) -> None:
+        self._request("DELETE", key, ok=(200, 204, 404))
+
+    def list(self, prefix: str) -> list[str]:
+        names: list[str] = []
+        token = ""
+        while True:
+            query = {"list-type": "2", "prefix": prefix}
+            if token:
+                query["continuation-token"] = token
+            _, body = self._request("GET", query=query)
+            root = ET.fromstring(body)
+            ns = ""
+            if root.tag.startswith("{"):
+                ns = root.tag[: root.tag.index("}") + 1]
+            names.extend(
+                el.text or ""
+                for el in root.iter(f"{ns}Key")
+            )
+            truncated = root.findtext(f"{ns}IsTruncated") == "true"
+            token = root.findtext(f"{ns}NextContinuationToken") or ""
+            if not truncated or not token:
+                return sorted(names)
+
+
+class S3Backend(ObjectStoreBackend):
+    """State backend over S3, injecting a ``terraform.backend.s3`` block so
+    terraform's own tfstate is co-located (reference contract:
+    backend/backend.go:24-26; Manta analog backend/manta/backend.go:196-205).
+    """
+
+    name = "s3"
+
+    def __init__(self, store: ObjectStore, bucket: str, region: str,
+                 lock_ttl_s: float = 3600.0):
+        super().__init__(store, bucket=bucket, lock_ttl_s=lock_ttl_s)
+        self.region = region
+
+    def state_terraform_config(self, name: str):
+        from tpu_kubernetes.backend.objectstore import PREFIX
+
+        cfg = {
+            "bucket": self.bucket,
+            "key": f"{PREFIX}/{name}/terraform.tfstate",
+            "region": self.region,
+        }
+        store = self.store
+        if isinstance(store, S3Store):
+            default = f"https://s3.{store.region}.amazonaws.com"
+            if store.base != default:
+                # S3-compatible endpoint: terraform must target the SAME
+                # store the documents live in, with the same credentials —
+                # otherwise tfstate silently lands on real AWS
+                cfg.update({
+                    "endpoint": store.base,
+                    "access_key": store.access_key,
+                    "secret_key": store.secret_key,
+                    "force_path_style": True,
+                    "skip_credentials_validation": True,
+                    "skip_metadata_api_check": True,
+                })
+        return "terraform.backend.s3", cfg
+
+
+def new_s3_backend(
+    bucket: str,
+    access_key: str,
+    secret_key: str,
+    region: str = "us-east-1",
+    endpoint: str = "",
+) -> S3Backend:
+    store = S3Store(
+        bucket, access_key=access_key, secret_key=secret_key,
+        region=region, endpoint=endpoint,
+    )
+    return S3Backend(store, bucket=bucket, region=region)

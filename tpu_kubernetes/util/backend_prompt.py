@@ -1,7 +1,8 @@
 """Backend selection (the reference's PromptForBackend).
 
 reference: util/backend_prompt.go:18-168 — choose Local or Manta (with full
-Manta credential prompting). Ours: local or gcs (the Manta analog).
+Manta credential prompting). Ours: local, gcs, or s3 (the Manta analogs;
+s3 also covers S3-compatible stores via ``s3_endpoint``).
 """
 
 from __future__ import annotations
@@ -9,7 +10,7 @@ from __future__ import annotations
 from tpu_kubernetes.backend import Backend, LocalBackend
 from tpu_kubernetes.config import Config
 
-BACKEND_PROVIDERS = ["local", "gcs"]
+BACKEND_PROVIDERS = ["local", "gcs", "s3"]
 
 
 def prompt_for_backend(cfg: Config) -> Backend:
@@ -26,4 +27,16 @@ def prompt_for_backend(cfg: Config) -> Backend:
 
         bucket = cfg.get("gcs_bucket", prompt="GCS bucket for state")
         return new_gcs_backend(str(bucket))
+    if provider == "s3":
+        # full credential prompting, like the reference's Manta flow
+        # (util/backend_prompt.go:49-168)
+        from tpu_kubernetes.backend import new_s3_backend
+
+        return new_s3_backend(
+            str(cfg.get("s3_bucket", prompt="S3 bucket for state")),
+            str(cfg.get("aws_access_key", prompt="AWS access key")),
+            str(cfg.get("aws_secret_key", prompt="AWS secret key", secret=True)),
+            region=str(cfg.get("aws_region", default="us-east-1")),
+            endpoint=str(cfg.get("s3_endpoint", default="")),
+        )
     raise ValueError(f"unknown backend provider {provider!r}")
