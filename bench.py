@@ -181,12 +181,20 @@ def measure_train(model_name: str, batch: int, seq: int, steps: int,
     )
 
     cfg = CONFIGS[model_name]
+    from dataclasses import replace
+
     if seq != cfg.max_seq:
         # honor the requested seq exactly (extend max_seq if needed) — a
         # silent clamp would compare different workloads across rounds
-        from dataclasses import replace
-
         cfg = replace(cfg, max_seq=seq)
+    remat_env = os.environ.get("BENCH_REMAT", "").lower()
+    if remat_env:
+        # rematerialization trades FLOPs for memory; when the bench shape
+        # fits HBM without it, the recompute is pure MFU loss — overridable
+        # per run (BENCH_REMAT=0/1)
+        cfg = replace(
+            cfg, remat=remat_env not in ("0", "false", "no", "off"),
+        )
 
     tc = TrainConfig(warmup_steps=10)
     t0 = time.perf_counter()

@@ -100,7 +100,8 @@ class FakeS3(BaseHTTPRequestHandler):
         s = self.server
         key = self._key()
         body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
-        if self.headers.get("If-None-Match") == "*":
+        honors_conditional = not getattr(s, "ignore_conditional", False)
+        if self.headers.get("If-None-Match") == "*" and honors_conditional:
             if key.endswith("always-conflict"):
                 # AWS's answer to SIMULTANEOUS conditional writes
                 return self._respond(
@@ -224,20 +225,40 @@ def test_endpoint_path_prefix_is_signed_and_requested(s3):
 
 def test_terraform_block_targets_the_custom_endpoint(s3):
     """With a custom endpoint, terraform's own backend must point at the
-    SAME store + credentials — not silently at real AWS (review finding)."""
+    SAME store — not silently at real AWS (review finding) — using the
+    terraform ≥1.6 argument names, and must NEVER embed the credentials
+    (the block is persisted in plaintext to the shared state bucket)."""
     store, _ = s3
     backend = S3Backend(store, bucket="state-bucket", region="us-east-1")
     _, cfg = backend.state_terraform_config("dev")
-    assert cfg["endpoint"] == store.base
-    assert cfg["access_key"] == "AKID" and cfg["secret_key"] == "sk"
-    assert cfg["force_path_style"] is True
-    # plain AWS: no endpoint/credential injection (ambient chain applies)
+    assert cfg["endpoints"] == {"s3": store.base}
+    assert cfg["use_path_style"] is True
+    assert "access_key" not in cfg and "secret_key" not in cfg
+    # plain AWS: no endpoint injection (ambient chain applies)
     aws = S3Backend(
         S3Store("b", access_key="a", secret_key="s", region="us-west-2"),
         bucket="b", region="us-west-2",
     )
     _, cfg2 = aws.state_terraform_config("dev")
-    assert "endpoint" not in cfg2 and "secret_key" not in cfg2
+    assert "endpoints" not in cfg2 and "secret_key" not in cfg2
+
+
+def test_endpoint_ignoring_conditional_writes_is_rejected(s3):
+    """An endpoint that silently IGNORES If-None-Match (pre-2024 S3
+    compatibles) would let both lock contenders win — the probe must catch
+    it up front instead of silently downgrading exclusivity (review
+    finding)."""
+    store, server = s3
+    server.ignore_conditional = True
+    try:
+        fresh = S3Store(
+            "state-bucket", access_key="AKID", secret_key="sk",
+            region="us-east-1", endpoint=store.base,
+        )
+        with pytest.raises(BackendError, match="does not honor conditional"):
+            fresh.put_if_absent("x/lock", b"v")
+    finally:
+        server.ignore_conditional = False
 
 
 def test_http_error_surfaces_as_backend_error(s3):

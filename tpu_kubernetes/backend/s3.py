@@ -138,6 +138,7 @@ class S3Store(ObjectStore):
         self.base = (endpoint.rstrip("/") if endpoint
                      else f"https://s3.{region}.amazonaws.com")
         self.timeout_s = timeout_s
+        self._conditional_verified = False
 
     def _request(
         self,
@@ -188,7 +189,32 @@ class S3Store(ObjectStore):
     def put(self, key: str, data: bytes) -> None:
         self._request("PUT", key, payload=data)
 
+    _NO_CONDITIONAL = (
+        "this S3 endpoint does not honor conditional writes "
+        "(If-None-Match) — state locking cannot be guaranteed; use the gcs "
+        "or local backend, or an S3 service with conditional-write support"
+    )
+
+    def _verify_conditional_writes(self) -> None:
+        """Once per store: prove the endpoint actually ENFORCES
+        If-None-Match. Endpoints that predate conditional writes often
+        ignore the unknown header and answer 200 — both lock contenders
+        would then 'win', which is precisely the Manta no-locking gap
+        (reference: backend/manta/backend.go:32) this backend closes."""
+        probe = ".tpu-kubernetes-conditional-write-probe"
+        self._request("PUT", probe, payload=b"probe")
+        status, _ = self._request(
+            "PUT", probe, payload=b"probe2",
+            headers={"If-None-Match": "*"}, ok=(200, 409, 412, 501),
+        )
+        self._request("DELETE", probe, ok=(200, 204, 404))
+        if status not in (409, 412):
+            raise BackendError(self._NO_CONDITIONAL)
+        self._conditional_verified = True
+
     def put_if_absent(self, key: str, data: bytes) -> bool:
+        if not self._conditional_verified:
+            self._verify_conditional_writes()
         # 412 = key exists; 409 = ConditionalRequestConflict, AWS's answer
         # to SIMULTANEOUS If-None-Match writes — the loser of a lock race,
         # i.e. contention, not an infrastructure error
@@ -197,12 +223,7 @@ class S3Store(ObjectStore):
             headers={"If-None-Match": "*"}, ok=(200, 409, 412, 501),
         )
         if status == 501:
-            raise BackendError(
-                "this S3 endpoint does not support conditional writes "
-                "(If-None-Match) — state locking cannot be guaranteed; use "
-                "the gcs or local backend, or an S3 service with "
-                "conditional-write support"
-            )
+            raise BackendError(self._NO_CONDITIONAL)
         return status == 200
 
     def delete(self, key: str) -> None:
@@ -256,15 +277,19 @@ class S3Backend(ObjectStoreBackend):
             default = f"https://s3.{store.region}.amazonaws.com"
             if store.base != default:
                 # S3-compatible endpoint: terraform must target the SAME
-                # store the documents live in, with the same credentials —
-                # otherwise tfstate silently lands on real AWS
+                # store the documents live in — otherwise tfstate silently
+                # lands on real AWS. Credentials are deliberately NOT
+                # embedded (the document is persisted to the shared state
+                # bucket in plaintext): terraform's s3 backend reads the
+                # standard AWS env chain — export AWS_ACCESS_KEY_ID /
+                # AWS_SECRET_ACCESS_KEY before apply. Argument names are
+                # the terraform ≥1.6 forms (endpoints.s3 / use_path_style).
                 cfg.update({
-                    "endpoint": store.base,
-                    "access_key": store.access_key,
-                    "secret_key": store.secret_key,
-                    "force_path_style": True,
+                    "endpoints": {"s3": store.base},
+                    "use_path_style": True,
                     "skip_credentials_validation": True,
                     "skip_metadata_api_check": True,
+                    "skip_requesting_account_id": True,
                 })
         return "terraform.backend.s3", cfg
 

@@ -30,6 +30,12 @@ def log(*args):
 def main() -> None:
     import jax
 
+    # honor an explicit JAX_PLATFORMS even where a sitecustomize re-forces
+    # a tunneled TPU platform at import (same stance as bench.py — local
+    # CPU smoke runs of the job entrypoint must be possible)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     from tpu_kubernetes.parallel import initialize
 
     t_start = time.time()
