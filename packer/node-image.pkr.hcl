@@ -1,0 +1,66 @@
+# Generic node image: pinned k3s + airgap images, nothing else.
+#
+# Reference analog: packer/rancher-host.yaml — the reference's third image
+# (docker-only host base, packer/packer-config:41-103) for plain worker/
+# control VMs that need fast boots but no TPU stack and no control-plane
+# manifests. Point gcp_image (or the AWS/Azure image knobs after importing
+# the artifact) at the built family.
+
+packer {
+  required_plugins {
+    googlecompute = {
+      version = ">= 1.1"
+      source  = "github.com/hashicorp/googlecompute"
+    }
+  }
+}
+
+variable "project_id" {
+  type = string
+}
+
+variable "zone" {
+  type    = string
+  default = "us-central1-a"
+}
+
+variable "source_image_family" {
+  type    = string
+  default = "ubuntu-2204-lts"
+}
+
+variable "source_image_project_id" {
+  type    = string
+  default = "ubuntu-os-cloud"
+}
+
+variable "k8s_version" {
+  # must match the version the node will install (cluster k8s_version for
+  # workers, the fleet version for control/etcd — docs/design/topology.md);
+  # the boot script skips the k3s download only on an exact match
+  type    = string
+  default = "v1.31.1"
+}
+
+source "googlecompute" "node" {
+  project_id              = var.project_id
+  zone                    = var.zone
+  source_image_family     = var.source_image_family
+  source_image_project_id = [var.source_image_project_id]
+  image_name              = "tpu-kubernetes-node-{{timestamp}}"
+  image_family            = "tpu-kubernetes-node"
+  machine_type            = "n2-standard-2"
+  disk_size               = 20
+  ssh_username            = "packer"
+}
+
+build {
+  sources = ["source.googlecompute.node"]
+
+  provisioner "shell" {
+    script           = "${path.root}/scripts/bake_node.sh"
+    environment_vars = [
+      "K8S_VERSION=${var.k8s_version}",
+    ]
+  }
+}

@@ -1,0 +1,20 @@
+#!/bin/sh
+# Bake the generic node image (run by packer inside the build VM):
+# just the pinned k3s binary + airgap images — the piece of node boot that
+# is network-bound (reference analog: the docker-only rancher-host image,
+# packer/packer-config:41-103).
+set -eu
+
+K8S_VERSION="${K8S_VERSION:-v1.31.1}"
+
+export DEBIAN_FRONTEND=noninteractive
+
+tag=$(printf '%s' "$K8S_VERSION+k3s1" | sed 's/+/%2B/')
+curl -sfL -o /usr/local/bin/k3s \
+  "https://github.com/k3s-io/k3s/releases/download/$tag/k3s"
+chmod +x /usr/local/bin/k3s
+mkdir -p /var/lib/rancher/k3s/agent/images
+curl -sfL -o /var/lib/rancher/k3s/agent/images/k3s-airgap-images-amd64.tar.zst \
+  "https://github.com/k3s-io/k3s/releases/download/$tag/k3s-airgap-images-amd64.tar.zst"
+
+echo "node bake complete (k3s $K8S_VERSION+k3s1)"

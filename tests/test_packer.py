@@ -26,9 +26,14 @@ FILES = Path(__file__).resolve().parent.parent / "terraform" / "modules" / "file
 IMAGES = sorted(PACKER.glob("*.pkr.hcl"))
 
 
-def test_both_images_exist():
+def test_all_three_images_exist():
+    """Three images, like the reference's rancher-host/server/agent trio
+    (packer/packer-config:41-103): node (k3s only), manager (+ manifests),
+    TPU agent (+ JAX stack and XLA cache)."""
     names = {p.name for p in IMAGES}
-    assert names == {"manager-image.pkr.hcl", "tpu-vm-image.pkr.hcl"}
+    assert names == {
+        "manager-image.pkr.hcl", "node-image.pkr.hcl", "tpu-vm-image.pkr.hcl",
+    }
 
 
 @pytest.mark.parametrize("hcl", IMAGES, ids=lambda p: p.name)
