@@ -4,14 +4,6 @@ variable "admin_password" {
   sensitive = true
 }
 
-variable "server_image" {
-  default = ""
-}
-
-variable "agent_image" {
-  default = ""
-}
-
 variable "aws_access_key" {}
 
 variable "aws_secret_key" {

@@ -4,14 +4,6 @@ variable "admin_password" {
   sensitive = true
 }
 
-variable "server_image" {
-  default = ""
-}
-
-variable "agent_image" {
-  default = ""
-}
-
 variable "azure_subscription_id" {}
 
 variable "azure_client_id" {}

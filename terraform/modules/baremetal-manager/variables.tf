@@ -7,16 +7,6 @@ variable "admin_password" {
   sensitive   = true
 }
 
-variable "server_image" {
-  description = "Override control-plane server image (empty = default)"
-  default     = ""
-}
-
-variable "agent_image" {
-  description = "Override node agent image (empty = default)"
-  default     = ""
-}
-
 variable "host" {
   description = "Existing host (IP or DNS) to install the manager on"
 }

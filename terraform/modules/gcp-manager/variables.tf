@@ -4,14 +4,6 @@ variable "admin_password" {
   sensitive = true
 }
 
-variable "server_image" {
-  default = ""
-}
-
-variable "agent_image" {
-  default = ""
-}
-
 variable "gcp_path_to_credentials" {
   description = "Path to a GCP service-account JSON file"
 }

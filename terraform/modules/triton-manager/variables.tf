@@ -4,14 +4,6 @@ variable "admin_password" {
   sensitive = true
 }
 
-variable "server_image" {
-  default = ""
-}
-
-variable "agent_image" {
-  default = ""
-}
-
 variable "triton_account" {}
 
 variable "triton_key_id" {

@@ -49,6 +49,19 @@ def test_job_trains_over_the_virtual_mesh_and_logs_the_marker(tmp_path):
     assert "done" in err
 
 
+def test_job_multislice_hybrid_mesh(tmp_path):
+    """JOB_DCN_MESH splits the virtual devices into 2 'slices' with the
+    data axis riding DCN and fsdp/tensor riding ICI — the multislice
+    topology the provisioner stands up for real (SURVEY §5.8)."""
+    proc = run_job(tmp_path, {
+        "JOB_DCN_MESH": "data=2",
+        "JOB_MESH": "fsdp=2,tensor=2",
+    })
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "mesh={'data': 2, 'fsdp': 2, 'tensor': 2}" in proc.stderr
+    assert "FIRST TRAIN STEP at +" in proc.stderr
+
+
 def test_job_checkpoints_and_resumes(tmp_path):
     ckpt = str(tmp_path / "ckpt")
     first = run_job(tmp_path, {

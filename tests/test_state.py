@@ -114,3 +114,21 @@ def test_dotted_names_rejected_dashed_hostnames_work():
     assert s.module("node_baremetal_alpha_10-0-0-21")["host"] == "10.0.0.21"
     s.delete_module("node_baremetal_alpha_10-0-0-21")
     assert s.nodes(ck) == {}
+
+
+def test_retired_module_keys_are_scrubbed_on_load():
+    """Documents persisted before a knob's retirement (round 3: the dead
+    rancher-image fields) must keep applying — the loader drops keys no
+    module declares anymore instead of failing terraform validation."""
+    import json
+
+    from tpu_kubernetes.state import State
+
+    legacy = json.dumps({"module": {"cluster-manager": {
+        "source": "x", "name": "m",
+        "server_image": "", "agent_image": "", "admin_password": "p",
+    }}})
+    state = State("m", legacy)
+    mgr = state.manager()
+    assert "server_image" not in mgr and "agent_image" not in mgr
+    assert mgr["admin_password"] == "p"  # everything else untouched

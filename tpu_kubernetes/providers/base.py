@@ -134,8 +134,11 @@ def base_manager_config(ctx: BuildContext, provider: str) -> dict[str, Any]:
         "admin_password": cfg.get(
             "manager_admin_password", prompt="control plane admin password", secret=True
         ),
-        "server_image": cfg.get("manager_server_image", default=""),
-        "agent_image": cfg.get("manager_agent_image", default=""),
+        # Departure: the reference also collects rancher server/agent
+        # container images here (create/manager.go:16-27); k3s has no such
+        # containers — the pinned k8s_version below plus the packer machine
+        # images (packer/) are their replacement, so the knobs don't exist
+        # rather than existing dead.
         "k8s_version": cfg.get(
             "k8s_version", prompt="kubernetes version (fleet control plane)",
             choices=K8S_VERSIONS, default=K8S_VERSIONS[-1],

@@ -59,6 +59,22 @@ class State:
             self._doc = json.loads(data)
             if not isinstance(self._doc, dict):
                 raise StateError(f"state document for {name!r} is not a JSON object")
+        self._scrub_retired_keys()
+
+    # module-config keys that once existed but no module declares anymore;
+    # documents persisted before their retirement must not fail terraform
+    # validation forever (round 3 retired the dead rancher-image knobs —
+    # k3s has no server/agent containers)
+    _RETIRED_MODULE_KEYS = ("server_image", "agent_image")
+
+    def _scrub_retired_keys(self) -> None:
+        modules = self._doc.get("module")
+        if not isinstance(modules, dict):
+            return
+        for config in modules.values():
+            if isinstance(config, dict):
+                for key in self._RETIRED_MODULE_KEYS:
+                    config.pop(key, None)
 
     # -- dotted-path access ------------------------------------------------
     def get(self, path: str, default: Any = None) -> Any:
