@@ -21,7 +21,7 @@ import uuid
 from pathlib import Path
 from typing import Any
 
-from tpu_kubernetes.backend.base import Backend, BackendError, LockError
+from tpu_kubernetes.backend.base import Backend, LockError
 from tpu_kubernetes.state import State
 
 STATE_FILE = "main.tf.json"

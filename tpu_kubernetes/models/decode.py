@@ -30,7 +30,7 @@ in-tree model stack (training + serving entry points on the same params).
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -19,7 +19,7 @@ v5p-32 slice). TPU-first design decisions:
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass
 from typing import Any
 
 import jax

@@ -20,7 +20,7 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.sharding import Mesh, PartitionSpec
 from jax import shard_map  # requires jax ≥ 0.8 (pcast below does too)
 
 NEG_INF = -1e30

@@ -30,7 +30,6 @@ from tpu_kubernetes.catalog import (
     CatalogError,
     catalog_choices,
     catalog_validate,
-    get_catalog,
 )
 from tpu_kubernetes.config import Config
 from tpu_kubernetes.state import MANAGER_KEY, State
