@@ -1,0 +1,295 @@
+"""EXECUTE the bootstrap scripts (not just syntax-check them) against
+stubbed ``k3s``/``curl`` binaries in a scratch root.
+
+The manager bootstrap is the most complex provisioning script in the tree
+(CNI selection → pinned k3s install → manifest application → fleet
+registry → credential minting → join-credential publication); until now
+only its rendered text was asserted. Here the rendered script RUNS:
+
+  * a stub ``curl`` serves get.k3s.io (recording the install env/args the
+    piped installer receives) and fails on any unexpected URL,
+  * a stub ``k3s`` implements just enough kubectl to record every apply
+    and serve the fleet-admin token,
+  * absolute paths are rebased into the test root (a rendering-for-test
+    transform only — the template text itself is what production renders).
+
+reference analog: the boot chain install_docker_rancher.sh.tpl +
+install_rancher_master.sh.tpl + setup_rancher.sh.tpl, which the reference
+never executes in tests either (SURVEY §4 gap — carried forward knowingly
+there, closed here).
+"""
+
+from __future__ import annotations
+
+import base64
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from tpu_kubernetes.util.tftemplate import render_template_file
+
+FILES = Path(__file__).resolve().parent.parent / "terraform" / "modules" / "files"
+
+TOKEN_B64 = base64.b64encode(b"sa-token-abc").decode()
+
+
+def write_stubs(root: Path) -> Path:
+    """Stub bin dir: k3s + curl + hostnamectl recording into root/log/."""
+    bin_dir = root / "bin"
+    log = root / "log"
+    bin_dir.mkdir(parents=True, exist_ok=True)
+    log.mkdir(parents=True, exist_ok=True)
+
+    (bin_dir / "k3s").write_text(f"""#!/bin/sh
+# stub k3s: records kubectl invocations; answers the few reads the
+# bootstrap performs
+echo "k3s $*" >> {log}/k3s.log
+case "$*" in
+  --version*)
+    echo "k3s version $K3S_STUB_VERSION (stub)" ;;
+  "kubectl get --raw /readyz")
+    exit 0 ;;
+  *"get secret fleet-admin-token"*)
+    echo "{TOKEN_B64}" ;;
+  *"apply -f -"*|*"apply -f"*)
+    # capture manifests piped/pointed in
+    cat >> {log}/applied.log 2>/dev/null || true
+    echo "--8<--" >> {log}/applied.log ;;
+  *) : ;;
+esac
+exit 0
+""")
+    (bin_dir / "curl").write_text(f"""#!/bin/sh
+# stub curl: serve get.k3s.io with a recorder script; anything else is a
+# test failure surfaced loudly
+echo "curl $*" >> {log}/curl.log
+for a in "$@"; do
+  case "$a" in
+    https://get.k3s.io)
+      cat <<'INSTALLER'
+#!/bin/sh
+echo "INSTALL_K3S_VERSION=$INSTALL_K3S_VERSION" >> __LOG__/install.log
+echo "INSTALL_K3S_SKIP_DOWNLOAD=$INSTALL_K3S_SKIP_DOWNLOAD" >> __LOG__/install.log
+echo "args: $*" >> __LOG__/install.log
+INSTALLER
+      exit 0 ;;
+    *"/cacerts") printf '%s' "FAKE-CA-PEM"; exit 0 ;;
+    http*://*) echo "unexpected URL $a" >&2; exit 7 ;;
+  esac
+done
+exit 0
+""".replace("__LOG__", str(log)))
+    (bin_dir / "hostnamectl").write_text("#!/bin/sh\nexit 0\n")
+    for f in bin_dir.iterdir():
+        f.chmod(0o755)
+    return bin_dir
+
+
+def rebase(script: str, root: Path) -> str:
+    """Rebase the absolute paths the script touches into the test root —
+    the only test-side transform applied to the rendered text."""
+    for p in ("/etc/rancher", "/etc/tpu-kubernetes", "/etc/systemd",
+              "/etc/profile.d", "/opt/tpu-kubernetes", "/var/lib/rancher",
+              "/etc/fstab"):
+        script = script.replace(p, f"{root}{p}")
+    return script
+
+
+def run_script(script: str, root: Path, env: dict | None = None):
+    bin_dir = write_stubs(root)
+    path = root / "script.sh"
+    path.write_text(rebase(script, root))
+    return subprocess.run(
+        ["sh", str(path)],
+        capture_output=True, text=True, timeout=60,
+        stdin=subprocess.DEVNULL,  # the k3s stub's `cat` must never block
+        env={"PATH": f"{bin_dir}:/usr/bin:/bin", **(env or {})},
+    )
+
+
+MANAGER_VARS = dict(
+    admin_password="hunter2", manager_name="dev",
+    k8s_version="v1.30.2", network_provider="calico",
+    private_registry_b64="", private_registry_username_b64="",
+    private_registry_password_b64="",
+)
+
+
+def manager_script(**overrides) -> str:
+    return render_template_file(
+        FILES / "install_manager.sh.tpl", {**MANAGER_VARS, **overrides}
+    )
+
+
+def prep_manager_fs(root: Path) -> None:
+    # what a real host would have: the k3s server token file (written by
+    # the k3s server on first start — our stub doesn't, so pre-seed it)
+    tok = root / "var/lib/rancher/k3s/server"
+    tok.mkdir(parents=True)
+    (tok / "token").write_text("K10realservertoken::server:abc")
+
+
+def test_manager_bootstrap_end_to_end_calico(tmp_path):
+    prep_manager_fs(tmp_path)
+    proc = run_script(manager_script(), tmp_path)
+    assert proc.returncode == 0, proc.stderr
+
+    install = (tmp_path / "log/install.log").read_text()
+    # pinned version flowed into the installer env; calico disables the
+    # built-in flannel on the SERVER command line
+    assert "INSTALL_K3S_VERSION=v1.30.2+k3s1" in install
+    assert "args: server --cluster-init" in install
+    assert "--flannel-backend=none --disable-network-policy" in install
+
+    applied = (tmp_path / "log/applied.log").read_text()
+    k3s_log = (tmp_path / "log/k3s.log").read_text()
+    # CNI manifest applied BEFORE the JobSet controller (pods need a
+    # network before the controller can come up)
+    assert k3s_log.index("calico.yaml") < k3s_log.index("kubernetes-sigs/jobset")
+    # fleet-admin SA + token secret + clusterrolebinding created
+    assert "create serviceaccount fleet-admin" in k3s_log
+    assert "kubernetes.io/service-account-token" in applied
+    # the REAL server token file is what gets published for quorum joins
+    assert ("create secret generic join-credentials "
+            "--from-literal=server_token=K10realservertoken::server:abc"
+            ) in k3s_log
+
+    # credentials dropped where the api-key scrape reads them, mode 0600
+    secret = tmp_path / "etc/tpu-kubernetes/api_secret_key"
+    assert secret.read_text() == "sa-token-abc"
+    assert (secret.stat().st_mode & 0o777) == 0o600
+    assert (tmp_path / "etc/tpu-kubernetes/api_access_key"
+            ).read_text().strip() == "fleet-admin"
+
+
+def test_manager_bootstrap_flannel_keeps_builtin_cni(tmp_path):
+    prep_manager_fs(tmp_path)
+    proc = run_script(manager_script(network_provider="flannel"), tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    install = (tmp_path / "log/install.log").read_text()
+    assert "--flannel-backend=none" not in install
+    k3s_log = (tmp_path / "log/k3s.log").read_text()
+    assert "calico.yaml" not in k3s_log
+    assert "kubernetes-sigs/jobset" in k3s_log  # controller still installed
+
+
+def test_manager_bootstrap_prefers_baked_manifests(tmp_path):
+    prep_manager_fs(tmp_path)
+    manifests = tmp_path / "opt/tpu-kubernetes/manifests"
+    manifests.mkdir(parents=True)
+    (manifests / "calico.yaml").write_text("baked-calico")
+    (manifests / "jobset.yaml").write_text("baked-jobset")
+    proc = run_script(manager_script(), tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    k3s_log = (tmp_path / "log/k3s.log").read_text()
+    # airgap-first: the APPLIED paths are the baked files, never the URLs
+    assert "projectcalico" not in k3s_log
+    assert "jobset/releases" not in k3s_log
+    assert "opt/tpu-kubernetes/manifests/calico.yaml" in k3s_log
+    assert "opt/tpu-kubernetes/manifests/jobset.yaml" in k3s_log
+
+
+def test_manager_bootstrap_writes_registries_yaml(tmp_path):
+    prep_manager_fs(tmp_path)
+    reg = {
+        "private_registry_b64": base64.b64encode(b"registry.corp").decode(),
+        "private_registry_username_b64": base64.b64encode(b"user").decode(),
+        "private_registry_password_b64":
+            base64.b64encode(b"p'w$(x)").decode(),
+    }
+    proc = run_script(manager_script(**reg), tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    yaml_text = (tmp_path / "etc/rancher/k3s/registries.yaml").read_text()
+    assert "registry.corp" in yaml_text
+    # hostile password landed escaped, nothing executed
+    assert "p''w$(x)" in yaml_text
+
+
+def test_manager_skips_download_when_baked_binary_matches(tmp_path):
+    prep_manager_fs(tmp_path)
+    proc = run_script(
+        manager_script(), tmp_path, env={"K3S_STUB_VERSION": "v1.30.2+k3s1"}
+    )
+    assert proc.returncode == 0, proc.stderr
+    install = (tmp_path / "log/install.log").read_text()
+    assert "INSTALL_K3S_SKIP_DOWNLOAD=true" in install
+
+
+NODE_VARS = dict(
+    api_url="https://10.0.0.10:6443",
+    registration_token="abcdef.0123456789abcdef",
+    server_token="K10srv::server:tok", ca_checksum="",  # "" skips CA pin
+    hostname="node-1", extra_labels="pool=a,team=ml", node_role="worker",
+    k8s_version="v1.29.4", server_k8s_version="v1.30.2",
+    network_provider="calico", private_registry_b64="",
+    private_registry_username_b64="", private_registry_password_b64="",
+    data_disk_device="",
+)
+
+
+def node_script(**overrides) -> str:
+    return render_template_file(
+        FILES / "install_node_agent.sh.tpl", {**NODE_VARS, **overrides}
+    )
+
+
+def test_worker_join_runs_agent_with_cluster_version_and_labels(tmp_path):
+    proc = run_script(node_script(), tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    install = (tmp_path / "log/install.log").read_text()
+    assert "INSTALL_K3S_VERSION=v1.29.4+k3s1" in install
+    line = [ln for ln in install.splitlines() if ln.startswith("args:")][0]
+    assert " agent " in line
+    assert "--token abcdef.0123456789abcdef" in line
+    assert "--node-label tpu-kubernetes/role=worker" in line
+    assert "--node-label pool=a" in line and "--node-label team=ml" in line
+    assert "--flannel-backend" not in line  # CNI flags are server-only
+
+
+def test_control_join_runs_server_with_manager_version_and_cni(tmp_path):
+    proc = run_script(node_script(node_role="control"), tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    install = (tmp_path / "log/install.log").read_text()
+    assert "INSTALL_K3S_VERSION=v1.30.2+k3s1" in install  # MANAGER's version
+    line = [ln for ln in install.splitlines() if ln.startswith("args:")][0]
+    assert " server " in line
+    assert "--token K10srv::server:tok" in line
+    assert "--flannel-backend=none --disable-network-policy" in line
+
+
+def test_data_disk_is_formatted_and_mounted_once(tmp_path):
+    """The disk branch with a real (loopback-free) fake block device can't
+    exist in the sandbox; assert the degrade path instead: no candidate
+    appears → loud warning + marker, boot continues to the join."""
+    script = node_script(data_disk_device="/dev/definitely-absent")
+    # shrink the 10-min wait to one iteration for the test
+    script = script.replace("[ $i -le 300 ]", "[ $i -le 1 ]")
+    assert "[ $i -le 1 ]" in script  # template drift must fail loudly here
+    proc = run_script(script, tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "never appeared" in proc.stderr
+    assert (tmp_path / "etc/tpu-kubernetes/data-disk-missing").exists()
+    install = (tmp_path / "log/install.log").read_text()
+    assert " agent " in install  # the node still joined
+
+
+def test_matching_ca_checksum_pin_allows_join(tmp_path):
+    """Positive pin: the checksum of exactly what /cacerts serves lets the
+    join proceed (the stub serves FAKE-CA-PEM)."""
+    import hashlib
+
+    good = hashlib.sha256(b"FAKE-CA-PEM").hexdigest()
+    proc = run_script(node_script(ca_checksum=good), tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert " agent " in (tmp_path / "log/install.log").read_text()
+
+
+def test_ca_checksum_mismatch_aborts_join(tmp_path):
+    """With a pinned checksum, a CA that hashes differently must abort
+    BEFORE any k3s install (the reference pins --ca-checksum the same
+    way)."""
+    proc = run_script(node_script(ca_checksum="0" * 64), tmp_path)
+    assert proc.returncode != 0
+    assert "CA checksum mismatch" in proc.stderr
+    assert not (tmp_path / "log/install.log").exists()
