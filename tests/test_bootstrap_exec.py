@@ -75,6 +75,11 @@ echo "args: $*" >> __LOG__/install.log
 INSTALLER
       exit 0 ;;
     *"/cacerts") printf '%s' "FAKE-CA-PEM"; exit 0 ;;
+    *agent-worker-number) printf '2'; exit 0 ;;
+    *worker-network-endpoints)
+      printf '0:x:10.0.0.20,1:x:10.0.0.21,2:x:10.0.0.22,3:x:10.0.0.23'
+      exit 0 ;;
+    http://metadata.google.internal/*) printf ''; exit 0 ;;
     http*://*) echo "unexpected URL $a" >&2; exit 7 ;;
   esac
 done
@@ -91,7 +96,7 @@ def rebase(script: str, root: Path) -> str:
     the only test-side transform applied to the rendered text."""
     for p in ("/etc/rancher", "/etc/tpu-kubernetes", "/etc/systemd",
               "/etc/profile.d", "/opt/tpu-kubernetes", "/var/lib/rancher",
-              "/etc/fstab"):
+              "/etc/fstab", "/dev/accel", "/dev/vfio"):
         script = script.replace(p, f"{root}{p}")
     return script
 
@@ -293,3 +298,59 @@ def test_ca_checksum_mismatch_aborts_join(tmp_path):
     assert proc.returncode != 0
     assert "CA checksum mismatch" in proc.stderr
     assert not (tmp_path / "log/install.log").exists()
+
+
+TPU_VARS = dict(
+    api_url="https://10.0.0.10:6443", registration_token="abcdef.0123",
+    ca_checksum="", slice_name="trainer-1", accelerator_type="v5p-32",
+    slice_topology="2x2x4", num_hosts=4, coordinator_port=8476,
+    k8s_version="v1.30.2", private_registry_b64="",
+    private_registry_username_b64="", private_registry_password_b64="",
+)
+
+
+def tpu_script(**overrides) -> str:
+    return render_template_file(
+        FILES / "install_tpu_agent.sh.tpl", {**TPU_VARS, **overrides}
+    )
+
+
+def test_tpu_agent_wires_jax_distributed_env_and_joins(tmp_path):
+    """The full slice-host boot: platform metadata → jax.distributed env
+    contract → worker join labeled with the slice identity → TPU health
+    gate (SURVEY §5.8 — the analog of the agent's server/token/checksum
+    trio extended with the collective-bootstrap facts)."""
+    (tmp_path / "dev").mkdir()
+    (tmp_path / "dev/accel0").write_text("")  # libtpu device visible
+    (tmp_path / "etc/profile.d").mkdir(parents=True)  # exists on real hosts
+    proc = run_script(tpu_script(), tmp_path)
+    assert proc.returncode == 0, proc.stderr
+
+    env_text = (tmp_path / "etc/tpu-kubernetes/jax.env").read_text()
+    # coordinator = FIRST worker's IP from the platform metadata; identity
+    # = this host's agent-worker-number
+    assert "JAX_COORDINATOR_ADDRESS=10.0.0.20:8476" in env_text
+    assert "JAX_PROCESS_ID=2" in env_text
+    assert "JAX_NUM_PROCESSES=4" in env_text
+    assert "TPU_SLICE_TOPOLOGY=2x2x4" in env_text
+    # login shells get the same exports
+    profile = (tmp_path / "etc/profile.d/tpu-kubernetes.sh").read_text()
+    assert "export JAX_COORDINATOR_ADDRESS=10.0.0.20:8476" in profile
+
+    install = (tmp_path / "log/install.log").read_text()
+    assert "INSTALL_K3S_VERSION=v1.30.2+k3s1" in install
+    line = [ln for ln in install.splitlines() if ln.startswith("args:")][0]
+    assert " agent " in line
+    assert "--node-label tpu-kubernetes/slice=trainer-1" in line
+    assert "--node-label tpu-kubernetes/slice-host=2" in line
+    assert "--node-label tpu-kubernetes/accelerator=v5p-32" in line
+
+
+def test_tpu_agent_health_gate_fails_without_devices(tmp_path):
+    """No /dev/accel* and no /dev/vfio/* → the readiness gate must fail
+    the boot loudly (SURVEY §5.3: TPU-VM readiness gate)."""
+    (tmp_path / "dev").mkdir()  # exists but empty
+    (tmp_path / "etc/profile.d").mkdir(parents=True)
+    proc = run_script(tpu_script(), tmp_path)
+    assert proc.returncode != 0
+    assert "TPU devices not visible" in proc.stderr
