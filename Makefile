@@ -3,7 +3,7 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast bench dryrun native dist clean
+.PHONY: test test-fast bench dryrun native dist dist-offline clean
 
 test:
 	python -m pytest tests/ -q
@@ -25,6 +25,12 @@ dryrun:
 
 dist: clean
 	python -m build
+	cd dist && sha256sum * > SHA256SUMS
+
+# hermetic variant for offline envs: builds with the ambient setuptools
+# instead of an isolated env (release artifacts should come from `dist`)
+dist-offline: clean
+	python -m build --no-isolation
 	cd dist && sha256sum * > SHA256SUMS
 
 clean:

@@ -79,7 +79,6 @@ INSTALLER
     *worker-network-endpoints)
       printf '0:x:10.0.0.20,1:x:10.0.0.21,2:x:10.0.0.22,3:x:10.0.0.23'
       exit 0 ;;
-    http://metadata.google.internal/*) printf ''; exit 0 ;;
     http*://*) echo "unexpected URL $a" >&2; exit 7 ;;
   esac
 done

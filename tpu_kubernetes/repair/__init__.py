@@ -25,6 +25,7 @@ from tpu_kubernetes.create.node import select_cluster, select_manager
 from tpu_kubernetes.providers.base import ProviderError
 from tpu_kubernetes.shell import Executor
 from tpu_kubernetes.shell.executor import dry_run_skip
+from tpu_kubernetes.util.runlog import run_recorder
 from tpu_kubernetes.util.trace import TRACER
 
 __all__ = ["repair_cluster"]
@@ -35,10 +36,12 @@ def repair_cluster(backend: Backend, cfg: Config, executor: Executor) -> list[st
     (empty when running dry — nothing was actually repaired). The document
     itself is never mutated, so there is nothing to persist."""
     manager = select_manager(backend, cfg)
-    with backend.lock(manager):
+    with run_recorder(backend, manager, "repair cluster") as run_info, \
+            backend.lock(manager):
         state = backend.state(manager)
         cluster_key = select_cluster(state, cfg)
         node_keys = sorted(state.nodes(cluster_key).values())
+        run_info["cluster"] = cluster_key
         replace = cfg.get_bool("replace_nodes", default=False)
 
         action = "Replace the nodes of" if replace else "Repair"
