@@ -15,6 +15,12 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
+# each test spawns a fresh interpreter that compiles over the virtual mesh
+# (~30-60s apiece) — `make test-fast` skips them, CI runs them
+pytestmark = pytest.mark.slow
+
 REPO = Path(__file__).resolve().parent.parent
 
 
