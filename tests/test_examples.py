@@ -121,3 +121,51 @@ def test_hybrid_aws_plus_tpu_example(cli_home):
     )
     assert m["cluster_aws_gpu-pool"]["k8s_version"] == "v1.31.1"
     assert m["cluster_gcp-tpu_tpu-pool"]["k8s_version"] == "v1.31.1"
+
+
+def test_job_manifest_targets_what_the_cluster_example_provisions(cli_home):
+    """Cross-artifact contract: the shipped JobSet manifest must schedule
+    onto exactly the slices the shipped cluster example creates — slice
+    label, host parallelism, chips per host, and mesh must all agree, or
+    the README flow dies at scheduling time with zero feedback."""
+    import yaml
+
+    from tpu_kubernetes.topology import parse_accelerator_type
+
+    tk_home, creds = cli_home
+    assert main([
+        "--config", f"{EXAMPLES}/create-manager.yaml", "--non-interactive",
+        "--set", f"gcp_path_to_credentials={creds}",
+        "create", "manager",
+    ]) == 0
+    assert main([
+        "--config", f"{EXAMPLES}/cluster-gcp-tpu-v5p32.yaml",
+        "--non-interactive", "--set", f"gcp_path_to_credentials={creds}",
+        "create", "cluster",
+    ]) == 0
+    doc = json.loads((tk_home / "global-manager" / "main.tf.json").read_text())
+    slices = {k: v for k, v in doc["module"].items()
+              if k.startswith("node_gcp-tpu_tpu-train_")}
+
+    with open("examples/jobs/llama7b-v5p32.yaml") as f:
+        jobset = yaml.safe_load(f)
+    job = jobset["spec"]["replicatedJobs"][0]["template"]["spec"]
+    pod = job["template"]["spec"]
+
+    # the nodeSelector must name a slice the example actually creates
+    target = pod["nodeSelector"]["tpu-kubernetes/slice"]
+    key = f"node_gcp-tpu_tpu-train_{target}"
+    assert key in slices, f"JobSet targets {target!r}, cluster creates {sorted(slices)}"
+    slice_cfg = slices[key]
+
+    # one pod per slice host; chips-per-host matches the accelerator
+    assert job["parallelism"] == slice_cfg["tpu_hosts"]
+    assert job["completions"] == slice_cfg["tpu_hosts"]
+    topo = parse_accelerator_type("v5p-32")
+    chips_per_host = topo.chips // topo.hosts
+    tpu_limit = int(pod["containers"][0]["resources"]["limits"]["google.com/tpu"])
+    assert tpu_limit == chips_per_host
+
+    # the job's mesh is the one the cluster example validated at render time
+    env = {e["name"]: e.get("value") for e in pod["containers"][0]["env"]}
+    assert env["JOB_MESH"] == "data=1,fsdp=8,tensor=2"
