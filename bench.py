@@ -318,6 +318,17 @@ def main() -> None:
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    # persistent compile cache (shared helper — the job runtime uses the
+    # same one): repeat runs skip compilation, which on a tunneled chip
+    # also skips a flaky remote-compile service (observed: HTTP 500s for
+    # larger programs). Opt out with BENCH_CACHE_DIR="".
+    from tpu_kubernetes.parallel import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache(os.environ.get(
+        "BENCH_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    ))
+
     from tpu_kubernetes.parallel import initialize
 
     initialize()  # no-op on single host; assembles the slice on multi-host

@@ -32,6 +32,9 @@ def run_job(tmp_path, extra_env: dict[str, str], timeout: int = 420):
            if k not in ("PALLAS_AXON_POOL_IPS", "TPU_ACCELERATOR_TYPE")},
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        # keep the persistent compile cache inside the test sandbox (the
+        # production default is /var/cache/tpu-kubernetes/xla)
+        "JAX_COMPILATION_CACHE_DIR": str(tmp_path / "xla-cache"),
         "JOB_MODEL": "llama-test",
         "JOB_BATCH": "8",
         "JOB_SEQ": "64",

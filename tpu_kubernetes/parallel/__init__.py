@@ -2,6 +2,7 @@
 context parallelism for the in-tree training stack."""
 
 from tpu_kubernetes.parallel.distributed import (  # noqa: F401
+    enable_persistent_compile_cache,
     DistributedEnv,
     initialize,
     read_env,

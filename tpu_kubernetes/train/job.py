@@ -36,9 +36,15 @@ def main() -> None:
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-    from tpu_kubernetes.parallel import initialize
+    from tpu_kubernetes.parallel import (
+        enable_persistent_compile_cache,
+        initialize,
+    )
 
     t_start = time.time()
+    cache = enable_persistent_compile_cache()
+    if cache:
+        log(f"compile cache: {cache}")
     denv = initialize()
     log(f"process {denv.process_id}/{denv.num_processes} "
         f"accelerator={denv.accelerator_type} topology={denv.slice_topology}")
