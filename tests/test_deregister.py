@@ -1,0 +1,173 @@
+"""Cluster deregistration on destroy: the join credential must die with
+the cluster.
+
+``terraform destroy`` removes cloud resources but not the registration
+living in the manager's kube API — and the bootstrap token Secret would
+keep authenticating agent joins for a cluster that no longer exists. The
+reference leaks its Rancher registration the same way (destroy/cluster.go
+never talks to Rancher); these tests pin our closing of that gap, and that
+deregistration failures degrade to warnings (the infra is already gone —
+nothing may fail the destroy).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpu_kubernetes.destroy.deregister import deregister_cluster
+
+SECRET_KEY = "sa-token-xyz"
+
+
+class FakeKube(BaseHTTPRequestHandler):
+    def _send(self, code, obj=None):
+        body = json.dumps(obj or {}).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _authed(self):
+        return self.headers.get("Authorization") == f"Bearer {SECRET_KEY}"
+
+    def do_GET(self):  # noqa: N802
+        if not self._authed():
+            return self._send(401)
+        s = self.server
+        name = self.path.rsplit("/", 1)[-1]
+        if "/configmaps/" in self.path and name in s.configmaps:
+            return self._send(200, s.configmaps[name])
+        self._send(404)
+
+    def do_DELETE(self):  # noqa: N802
+        if not self._authed():
+            return self._send(401)
+        s = self.server
+        name = self.path.rsplit("/", 1)[-1]
+        if "/configmaps/" in self.path:
+            return self._send(200 if s.configmaps.pop(name, None) else 404)
+        if "/secrets/" in self.path:
+            return self._send(200 if s.secrets.pop(name, None) else 404)
+        self._send(404)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def kube():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), FakeKube)
+    server.configmaps = {
+        "cluster-alpha": {
+            "metadata": {"name": "cluster-alpha"},
+            "data": {"cluster_id": "c-1",
+                     "registration_token": "abc123.0123456789abcdef"},
+        },
+    }
+    server.secrets = {"bootstrap-token-abc123": {"present": True}}
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield server, f"http://127.0.0.1:{server.server_address[1]}"
+    finally:
+        server.shutdown()
+        thread.join(timeout=5)
+
+
+def test_deregister_revokes_token_and_registry_record(kube):
+    server, url = kube
+    assert deregister_cluster(url, SECRET_KEY, "alpha") is True
+    assert server.configmaps == {}   # registry record gone
+    assert server.secrets == {}      # join credential revoked
+
+
+def test_deregister_unknown_cluster_is_clean_noop(kube):
+    server, url = kube
+    assert deregister_cluster(url, SECRET_KEY, "ghost") is True
+    # existing registrations untouched
+    assert "cluster-alpha" in server.configmaps
+    assert "bootstrap-token-abc123" in server.secrets
+
+
+def test_unreachable_manager_warns_but_never_raises(capsys):
+    assert deregister_cluster("http://127.0.0.1:9", SECRET_KEY, "alpha") is False
+    assert "deregistration skipped" in capsys.readouterr().err
+
+
+def test_destroy_cluster_workflow_deregisters(kube, tmp_path):
+    """End-to-end through delete_cluster: after terraform destroy, the
+    manager no longer holds the pool's record or token."""
+    from tpu_kubernetes.backend.local import LocalBackend
+    from tpu_kubernetes.config import Config
+    from tpu_kubernetes.create.cluster import new_cluster
+    from tpu_kubernetes.create.manager import new_manager
+    from tpu_kubernetes.destroy.workflows import delete_cluster
+    from tpu_kubernetes.shell.executor import FakeExecutor
+    from tpu_kubernetes.state import MANAGER_KEY
+
+    server, url = kube
+    backend = LocalBackend(root=tmp_path)
+    ex = FakeExecutor(outputs={MANAGER_KEY: {
+        "api_url": url, "access_key": "fleet-admin", "secret_key": SECRET_KEY,
+    }})
+
+    def cfg(values):
+        return Config(values={**values, "confirm": True},
+                      non_interactive=True, env={})
+
+    new_manager(backend, cfg({
+        "manager_cloud_provider": "baremetal", "name": "dev",
+        "manager_admin_password": "pw", "host": "10.0.0.10",
+    }), ex)
+    new_cluster(backend, cfg({
+        "cluster_manager": "dev", "cluster_cloud_provider": "baremetal",
+        "name": "alpha",
+    }), ex)
+
+    delete_cluster(backend, cfg({
+        "cluster_manager": "dev", "cluster_name": "alpha",
+    }), ex)
+    assert "cluster-alpha" not in server.configmaps
+    assert "bootstrap-token-abc123" not in server.secrets
+    # and the run report reflects the destroy
+    assert backend.last_run_report("dev")["command"] == "destroy cluster"
+
+
+def test_dry_run_destroy_does_not_deregister(kube, tmp_path):
+    """Dry-run keeps state AND keeps the registration: nothing was
+    actually destroyed, so the credentials must stay valid."""
+    from tpu_kubernetes.backend.local import LocalBackend
+    from tpu_kubernetes.config import Config
+    from tpu_kubernetes.create.cluster import new_cluster
+    from tpu_kubernetes.create.manager import new_manager
+    from tpu_kubernetes.destroy.workflows import delete_cluster
+    from tpu_kubernetes.shell.executor import FakeExecutor
+    from tpu_kubernetes.state import MANAGER_KEY
+
+    server, url = kube
+    backend = LocalBackend(root=tmp_path)
+    ex = FakeExecutor(dry_run=True, outputs={MANAGER_KEY: {
+        "api_url": url, "secret_key": SECRET_KEY,
+    }})
+
+    def cfg(values):
+        return Config(values={**values, "confirm": True},
+                      non_interactive=True, env={})
+
+    new_manager(backend, cfg({
+        "manager_cloud_provider": "baremetal", "name": "dev",
+        "manager_admin_password": "pw", "host": "10.0.0.10",
+    }), ex)
+    new_cluster(backend, cfg({
+        "cluster_manager": "dev", "cluster_cloud_provider": "baremetal",
+        "name": "alpha",
+    }), ex)
+    delete_cluster(backend, cfg({
+        "cluster_manager": "dev", "cluster_name": "alpha",
+    }), ex)
+    assert "cluster-alpha" in server.configmaps
+    assert "bootstrap-token-abc123" in server.secrets

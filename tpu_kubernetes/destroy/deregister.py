@@ -1,0 +1,102 @@
+"""Deregister a destroyed cluster from the fleet control plane.
+
+``terraform destroy`` removes the cloud resources, but the cluster's
+registration lives in the MANAGER's kube API: the fleet registry ConfigMap
+(``tpu-fleet/cluster-<name>``) and — security-relevant — the
+``bootstrap.kubernetes.io/token`` Secret minted at registration
+(register_cluster.sh). Left behind, that token still authenticates agent
+joins: any host holding it could re-join a "destroyed" cluster's
+credentials. The reference has the same leak (its Rancher cluster object
+and registration token survive ``destroy cluster`` — nothing in
+destroy/cluster.go:16-161 talks to Rancher), carried knowingly there and
+closed here.
+
+Best-effort by design: the manager may itself be gone or unreachable, and
+the infrastructure is already destroyed — a deregistration failure must
+never fail the destroy. It warns, and re-registration under the same name
+would mint a fresh token anyway (register_cluster.sh re-mint path).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+import urllib.error
+import urllib.request
+
+from tpu_kubernetes.util.bootstrap_tls import urlopen_kwargs
+
+_TOKEN_RE = re.compile(r"^([a-z0-9]{6})\.[a-z0-9]{16}$")
+
+
+def _request(
+    method: str, url: str, token: str, timeout_s: float = 10.0
+) -> tuple[int, bytes]:
+    req = urllib.request.Request(url, method=method)
+    req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(
+            req, timeout=timeout_s, **urlopen_kwargs(url)
+        ) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _warn(msg: str) -> None:
+    print(f"[tpu-k8s] WARNING: {msg}", file=sys.stderr)
+
+
+def deregister_cluster(
+    api_url: str, secret_key: str, cluster_name: str
+) -> bool:
+    """Delete the cluster's registry record and revoke its bootstrap token.
+    Returns True when fully deregistered; False (with a stderr warning)
+    on any failure — callers must not treat that as a destroy failure.
+    Never raises: the infrastructure is already gone."""
+    base = api_url.rstrip("/")
+    cm_url = f"{base}/api/v1/namespaces/tpu-fleet/configmaps/cluster-{cluster_name}"
+    try:
+        # read the record first: it names the bootstrap token to revoke
+        status, body = _request("GET", cm_url, secret_key)
+        token_id = None
+        if status == 200:
+            try:
+                doc = json.loads(body)
+                data = doc.get("data") or {}
+                token = data.get("registration_token", "")
+            except (ValueError, AttributeError, TypeError):
+                token = ""
+            m = _TOKEN_RE.match(token if isinstance(token, str) else "")
+            if m:
+                token_id = m.group(1)
+
+        failures = []
+        if token_id:
+            status, _ = _request(
+                "DELETE",
+                f"{base}/api/v1/namespaces/kube-system/secrets/"
+                f"bootstrap-token-{token_id}",
+                secret_key,
+            )
+            if status not in (200, 202, 404):
+                failures.append(f"bootstrap token Secret (HTTP {status})")
+        status, _ = _request("DELETE", cm_url, secret_key)
+        if status not in (200, 202, 404):
+            failures.append(f"registry ConfigMap (HTTP {status})")
+        if failures:
+            _warn(
+                f"could not fully deregister cluster {cluster_name!r} from "
+                f"the manager — failed: {', '.join(failures)}; its join "
+                "token may still be valid. Delete the "
+                f"tpu-fleet/cluster-{cluster_name} ConfigMap and the "
+                "bootstrap token Secret by hand"
+            )
+        return not failures
+    except Exception as e:  # noqa: BLE001 — must never fail a finished destroy
+        _warn(
+            f"cluster {cluster_name!r} deregistration skipped ({e}) — "
+            "manager unreachable? Its join token may still be valid"
+        )
+        return False

@@ -8,13 +8,17 @@ node module).
 
 from __future__ import annotations
 
+import sys
+
 from tpu_kubernetes.backend import Backend
 from tpu_kubernetes.config import Config
 from tpu_kubernetes.create.node import select_cluster, select_manager
+from tpu_kubernetes.destroy.deregister import deregister_cluster
 from tpu_kubernetes.providers.base import ProviderError
 from tpu_kubernetes.shell import Executor
 from tpu_kubernetes.shell.executor import dry_run_skip
 from tpu_kubernetes.shell.outputs import inject_root_outputs
+from tpu_kubernetes.state import MANAGER_KEY, cluster_key_parts
 from tpu_kubernetes.util.runlog import run_recorder
 from tpu_kubernetes.util.trace import TRACER
 
@@ -73,6 +77,32 @@ def delete_cluster(backend: Backend, cfg: Config, executor: Executor) -> None:
                 state.delete_module(key)
             inject_root_outputs(state)  # drop forwards of deleted modules
             backend.persist_state(state)
+
+            # revoke the pool's join credential on the manager — left
+            # behind, the bootstrap token still authenticates agent joins
+            # (the reference leaks its Rancher registration the same way;
+            # best-effort by design: the infrastructure is already gone, so
+            # NOTHING here may fail the destroy — see destroy/deregister.py)
+            parts = cluster_key_parts(cluster_key)
+            try:
+                outputs = executor.output(state, MANAGER_KEY)
+            except Exception as e:  # noqa: BLE001
+                outputs = {}
+                print(f"[tpu-k8s] WARNING: could not read manager outputs "
+                      f"for deregistration ({e})", file=sys.stderr)
+            api_url = outputs.get("api_url")
+            secret_key = outputs.get("secret_key")
+            if parts and api_url and secret_key:
+                with TRACER.phase("deregister cluster", cluster=cluster_key):
+                    deregister_cluster(str(api_url), str(secret_key), parts[1])
+            else:
+                print(
+                    f"[tpu-k8s] WARNING: cluster {cluster_key} was NOT "
+                    "deregistered from the manager (no live api_url/"
+                    "secret_key outputs) — its join token may still be "
+                    "valid; see tpu_kubernetes/destroy/deregister.py",
+                    file=sys.stderr,
+                )
 
 
 def delete_node(backend: Backend, cfg: Config, executor: Executor) -> None:

@@ -21,7 +21,6 @@ from __future__ import annotations
 import base64
 import hashlib
 import http.client
-import ssl
 import urllib.error
 import urllib.request
 
@@ -36,13 +35,10 @@ def fetch_ca_pem(api_url: str, timeout_s: float = 15.0) -> bytes:
     """GET <api_url>/cacerts. TLS is unverified here by necessity — this IS
     the trust bootstrap (the agents' ``curl -ks`` analog); the returned CA's
     checksum is surfaced for out-of-band verification."""
+    from tpu_kubernetes.util.bootstrap_tls import urlopen_kwargs
+
     url = api_url.rstrip("/") + "/cacerts"
-    kwargs = {}
-    if url.startswith("https:"):
-        ctx = ssl.create_default_context()
-        ctx.check_hostname = False
-        ctx.verify_mode = ssl.CERT_NONE
-        kwargs["context"] = ctx
+    kwargs = urlopen_kwargs(url)
     try:
         with urllib.request.urlopen(url, timeout=timeout_s, **kwargs) as resp:
             data = resp.read()

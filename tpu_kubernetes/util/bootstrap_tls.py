@@ -1,0 +1,25 @@
+"""The trust-bootstrap TLS stance, in one place.
+
+Talking to a manager's kube API before its CA is locally trusted (fetching
+/cacerts for a kubeconfig, revoking credentials during destroy) is the
+same first-contact problem the joining agents solve with ``curl -ks`` +
+checksum pinning (install_node_agent.sh.tpl). Both Python callers share
+this helper so a future hardening change (e.g. CA pinning from the fleet
+registry) lands in exactly one spot.
+"""
+
+from __future__ import annotations
+
+import ssl
+from typing import Any
+
+
+def urlopen_kwargs(url: str) -> dict[str, Any]:
+    """kwargs for ``urllib.request.urlopen``: an unverified SSL context for
+    https URLs (the trust bootstrap), nothing for http."""
+    if not url.startswith("https:"):
+        return {}
+    ctx = ssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    return {"context": ctx}
