@@ -164,6 +164,33 @@ def test_private_registry_writes_registries_yaml(tpl, vars_, tmp_path):
     assert "chmod 600 /etc/rancher/k3s/registries.yaml" in script
 
 
+def test_registry_blocks_are_identical_across_templates():
+    """terraform's templatefile() has no include mechanism, so the
+    registries.yaml block (and its sq escape helper) is necessarily
+    duplicated in all three install templates — this guard keeps the
+    copies from drifting apart (a fix applied to one copy only would
+    silently leave the others vulnerable/broken)."""
+    def block(name: str, start: str) -> str:
+        text = (FILES / name).read_text()
+        body = text.split(start, 1)[1]
+        return body.split("fi\n", 1)[0]
+
+    blocks = {
+        name: block(name, 'if [ -n "$PRIVATE_REGISTRY" ]')
+        for name in ("install_manager.sh.tpl", "install_node_agent.sh.tpl",
+                     "install_tpu_agent.sh.tpl")
+    }
+    assert len(set(blocks.values())) == 1, (
+        "registry blocks drifted between templates"
+    )
+    helpers = {
+        name: [ln for ln in (FILES / name).read_text().splitlines()
+               if ln.startswith("sq() ")]
+        for name in blocks
+    }
+    assert len({tuple(h) for h in helpers.values()}) == 1
+
+
 def test_registry_yaml_write_survives_hostile_password(tmp_path):
     """Execute the registry block (not just sh -n): the decoded hostile
     password must land in registries.yaml as an escaped YAML scalar, with

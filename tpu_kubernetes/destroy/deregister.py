@@ -100,3 +100,28 @@ def deregister_cluster(
             "manager unreachable? Its join token may still be valid"
         )
         return False
+
+
+def deregister_from_state(executor, state, cluster_key: str) -> bool:
+    """Workflow-level entry: resolve the manager's live outputs and
+    deregister ``cluster_key``. Same never-raises contract — every failure
+    mode (unreadable outputs, missing outputs, HTTP errors) degrades to a
+    warning, because the caller's infrastructure is already destroyed."""
+    from tpu_kubernetes.state import MANAGER_KEY, cluster_key_parts
+
+    parts = cluster_key_parts(cluster_key)
+    try:
+        outputs = executor.output(state, MANAGER_KEY)
+    except Exception as e:  # noqa: BLE001
+        outputs = {}
+        _warn(f"could not read manager outputs for deregistration ({e})")
+    api_url = outputs.get("api_url")
+    secret_key = outputs.get("secret_key")
+    if not (parts and api_url and secret_key):
+        _warn(
+            f"cluster {cluster_key} was NOT deregistered from the manager "
+            "(no live api_url/secret_key outputs) — its join token may "
+            "still be valid; see tpu_kubernetes/destroy/deregister.py"
+        )
+        return False
+    return deregister_cluster(str(api_url), str(secret_key), parts[1])

@@ -8,17 +8,14 @@ node module).
 
 from __future__ import annotations
 
-import sys
-
 from tpu_kubernetes.backend import Backend
 from tpu_kubernetes.config import Config
 from tpu_kubernetes.create.node import select_cluster, select_manager
-from tpu_kubernetes.destroy.deregister import deregister_cluster
+from tpu_kubernetes.destroy.deregister import deregister_from_state
 from tpu_kubernetes.providers.base import ProviderError
 from tpu_kubernetes.shell import Executor
 from tpu_kubernetes.shell.executor import dry_run_skip
 from tpu_kubernetes.shell.outputs import inject_root_outputs
-from tpu_kubernetes.state import MANAGER_KEY, cluster_key_parts
 from tpu_kubernetes.util.runlog import run_recorder
 from tpu_kubernetes.util.trace import TRACER
 
@@ -82,27 +79,10 @@ def delete_cluster(backend: Backend, cfg: Config, executor: Executor) -> None:
             # behind, the bootstrap token still authenticates agent joins
             # (the reference leaks its Rancher registration the same way;
             # best-effort by design: the infrastructure is already gone, so
-            # NOTHING here may fail the destroy — see destroy/deregister.py)
-            parts = cluster_key_parts(cluster_key)
-            try:
-                outputs = executor.output(state, MANAGER_KEY)
-            except Exception as e:  # noqa: BLE001
-                outputs = {}
-                print(f"[tpu-k8s] WARNING: could not read manager outputs "
-                      f"for deregistration ({e})", file=sys.stderr)
-            api_url = outputs.get("api_url")
-            secret_key = outputs.get("secret_key")
-            if parts and api_url and secret_key:
-                with TRACER.phase("deregister cluster", cluster=cluster_key):
-                    deregister_cluster(str(api_url), str(secret_key), parts[1])
-            else:
-                print(
-                    f"[tpu-k8s] WARNING: cluster {cluster_key} was NOT "
-                    "deregistered from the manager (no live api_url/"
-                    "secret_key outputs) — its join token may still be "
-                    "valid; see tpu_kubernetes/destroy/deregister.py",
-                    file=sys.stderr,
-                )
+            # nothing on this path may fail the destroy — every failure
+            # mode warns inside deregister_from_state)
+            with TRACER.phase("deregister cluster", cluster=cluster_key):
+                deregister_from_state(executor, state, cluster_key)
 
 
 def delete_node(backend: Backend, cfg: Config, executor: Executor) -> None:
