@@ -5,15 +5,27 @@ The attention path is shared with the dense model
 (models/llama.py:attention_sublayer); the SwiGLU MLP is replaced by a
 GShard-style top-k routed expert layer. TPU-first design:
 
-* **Static shapes everywhere**: routing uses expert-capacity
-  dispatch/combine one-hot tensors (no gather/scatter, no dynamic shapes),
-  so the whole layer is three einsums XLA maps straight onto the MXU.
+* **Static shapes everywhere**: routing is expert-capacity based, so every
+  array shape is a function of (batch, seq, n_experts, capacity) only.
+* **Indexed dispatch, not dense one-hot**: the default ``gather`` path
+  moves tokens to expert buckets with batched row gathers driven by int32
+  slot indices — O(b·(E·C + k·s)·d) bytes of HBM traffic — instead of the
+  GShard dense dispatch/combine einsums whose (b, s, E, C) one-hot
+  operands cost O(b·s·E·C·d) MXU flops that scale with *total* experts.
+  Custom VJPs express the backward pass as the complementary gathers, so
+  neither direction ever materializes a (b, s, E, C) tensor or scatters
+  activation rows. The dense-einsum path survives as
+  ``dispatch_mode="einsum"`` and is the numerical oracle in tests.
 * **Expert parallelism**: expert weights carry an ``expert`` logical axis
   which parallel/mesh.py maps to the ``expert`` mesh axis. Token
   activations are sharded over the data-like axes (which include
   ``expert`` — GShard's trick of reusing the expert axis for data
-  parallelism in the non-MoE path), so XLA inserts the all-to-all on the
-  dispatch/combine einsums and it rides ICI.
+  parallelism in the non-MoE path). On the ``einsum`` path XLA inserts the
+  all-to-all on the dispatch/combine einsums; on the default ``gather``
+  path the row gathers are batch-local (operand, indices and output all
+  shard over the token batch) and the cross-device exchange happens where
+  the bucketed activations meet the expert-sharded weights in the expert
+  matmuls — either way the collective rides ICI.
 * ``lax.scan`` over stacked layer params, exactly like the dense model:
   expert weights are stacked (n_layers, n_experts, ...) so one block is
   traced/compiled once.
@@ -33,6 +45,7 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tpu_kubernetes.models.llama import (
     ModelConfig,
@@ -50,6 +63,9 @@ class MoEConfig(ModelConfig):
     # per-expert token capacity = ceil(k · seq · capacity_factor / n_experts)
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
+    # "gather": indexed dispatch/combine (row gathers, custom-VJP backward)
+    # "einsum": GShard dense one-hot dispatch (oracle; O(b·s·E·C·d) flops)
+    dispatch_mode: str = "gather"
 
 
 MOE_CONFIGS: dict[str, MoEConfig] = {
@@ -138,6 +154,24 @@ def logical_axes(cfg: MoEConfig) -> dict:
 
 # -- routed expert layer ----------------------------------------------------
 
+def _topk_selection(gates: jax.Array, k: int):
+    """k argmax rounds over (b, s, E) gates → (idxs, masks), each a list of
+    k arrays ((b, s) int32 / (b, s, E) f32 one-hot). Shared by both
+    dispatch paths so their expert selection can never diverge."""
+    remaining = gates
+    idxs, masks = [], []
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)
+        mask = jax.nn.one_hot(idx, gates.shape[-1], dtype=jnp.float32)
+        idxs.append(idx.astype(jnp.int32))
+        masks.append(mask)
+        # chosen experts drop to -1 (not 0): even if every unchosen gate
+        # underflowed to exactly 0.0, argmax can never re-pick an expert,
+        # preserving the distinct-experts invariant slot assignment relies on
+        remaining = jnp.where(mask > 0, -1.0, remaining)
+    return idxs, masks
+
+
 def _route(gates: jax.Array, k: int, capacity: int):
     """Top-k expert-capacity routing. gates: (b, s, E) float32 softmax
     probabilities → (dispatch, combine) both (b, s, E, C), plus the
@@ -154,16 +188,7 @@ def _route(gates: jax.Array, k: int, capacity: int):
     Dropped tokens pass through on the residual; combine weights are
     renormalized over the *selected* experts (Mixtral semantics)."""
     b, s, E = gates.shape
-    remaining = gates
-    masks = []
-    for _ in range(k):
-        idx = jnp.argmax(remaining, axis=-1)              # (b, s)
-        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # (b, s, E)
-        masks.append(mask)
-        # chosen experts drop to -1 (not 0): even if every unchosen gate
-        # underflowed to exactly 0.0, argmax can never re-pick an expert,
-        # preserving the distinct-experts invariant pass 2 relies on
-        remaining = jnp.where(mask > 0, -1.0, remaining)
+    _, masks = _topk_selection(gates, k)
     first_mask = masks[0]
 
     # a token's slot in expert e = number of claims on e by strictly
@@ -187,6 +212,164 @@ def _route(gates: jax.Array, k: int, capacity: int):
     return dispatch, combine, first_mask
 
 
+def _route_plan(gates: jax.Array, k: int, capacity: int):
+    """Indexed form of :func:`_route`: the same k argmax rounds and causal
+    slot cumsum, but returned as per-round index/weight arrays instead of
+    dense (b, s, E, C) one-hots.
+
+    Returns (dst, keep, weight, first):
+      dst    (k, b, s) int32 — flat slot e·C + pos each round targets
+      keep   (k, b, s) bool  — slot within capacity (token not dropped)
+      weight (k, b, s) f32   — combine weight, gate renormalized over the
+                               token's k selected experts (differentiable —
+                               this is the router's gradient path)
+      first  (b, s, E) f32   — first-choice one-hot for the balance loss
+    """
+    b, s, E = gates.shape
+    idxs, masks = _topk_selection(gates, k)
+    first = masks[0]
+
+    total = sum(masks)
+    pos_all = jnp.cumsum(total, axis=1) - total       # (b, s, E) exclusive
+
+    expert_idx = jnp.stack(idxs)                      # (k, b, s)
+    pos = jnp.stack([
+        jnp.sum(pos_all * m, axis=-1).astype(jnp.int32) for m in masks
+    ])
+    gate_r = jnp.stack([
+        jnp.sum(gates * m, axis=-1) for m in masks
+    ])                                                # (k, b, s) f32
+
+    keep = pos < capacity
+    dst = expert_idx * capacity + pos
+    # renormalize over ALL selected experts (Mixtral semantics, matching
+    # _route: dropped selections still count in the denominator)
+    weight = gate_r / jnp.maximum(jnp.sum(gate_r, axis=0), 1e-9)
+    return dst, keep, weight, first
+
+
+def _slot_sources(dst, keep, n_slots: int):
+    """Invert the token→slot map: src[b, j] = sequence index of the token
+    claiming flat slot j, valid[b, j] = whether j is claimed. The only
+    scatter in the layer — int32 claims, O(b·k·s) values (no feature dim).
+    Routing guarantees claimed slots are unique, dropped claims go out of
+    bounds and are dropped by XLA."""
+    k, b, s = dst.shape
+    tok = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (k, b, s))
+    # dropped claims go out of bounds (mode="drop" discards them) at
+    # DISTINCT indices — unique_indices is a compiler promise that must
+    # hold for the dropped writes too
+    oob = b * n_slots + jnp.arange(k * b * s, dtype=jnp.int32).reshape(k, b, s)
+    flat = jnp.where(
+        keep,
+        dst + (jnp.arange(b, dtype=jnp.int32) * n_slots)[None, :, None],
+        oob,
+    )
+    claims = (
+        jnp.zeros((b * n_slots,), jnp.int32)
+        .at[flat.reshape(-1)]
+        .set(tok.reshape(-1) + 1, mode="drop", unique_indices=True)
+        .reshape(b, n_slots)
+    )
+    return jnp.maximum(claims - 1, 0), claims > 0
+
+
+def _take_rows(table, idx):
+    """Batched row gather: table (b, n, d), idx (b, m) → (b, m, d)."""
+    return jnp.take_along_axis(table, idx[..., None], axis=1)
+
+
+def _int_zeros(a):
+    """Symbolic-zero cotangent for an integer/bool primal."""
+    return np.zeros(a.shape, jax.dtypes.float0)
+
+
+@jax.custom_vjp
+def _dispatch_rows(y, src, valid, dst, keep):
+    """Token rows → expert slots: (b, s, d) → (b, E·C, d), unclaimed slots
+    zero. Backward is the complementary gather (each token reads the ≤k
+    slot cotangents it fed), so neither direction scatters feature rows."""
+    return _take_rows(y, src) * valid[..., None].astype(y.dtype)
+
+
+def _dispatch_rows_fwd(y, src, valid, dst, keep):
+    return _dispatch_rows(y, src, valid, dst, keep), (src, valid, dst, keep)
+
+
+def _dispatch_rows_bwd(res, dxe):
+    src, valid, dst, keep = res
+    n = dxe.shape[1]
+    dy = None
+    for r in range(dst.shape[0]):
+        rows = _take_rows(dxe, jnp.minimum(dst[r], n - 1))
+        rows = rows * keep[r][..., None].astype(dxe.dtype)
+        dy = rows if dy is None else dy + rows
+    return dy, _int_zeros(src), _int_zeros(valid), _int_zeros(dst), _int_zeros(keep)
+
+
+_dispatch_rows.defvjp(_dispatch_rows_fwd, _dispatch_rows_bwd)
+
+
+@jax.custom_vjp
+def _combine_rows(out_e, weight, dst, keep, src, valid):
+    """Expert-slot rows → tokens: out[b, s] = Σ_r weight_r · out_e[b, dst_r]
+    over the token's kept slots. Differentiable in ``weight`` (the router's
+    gradient path) and ``out_e``; backward is again gathers only."""
+    n = out_e.shape[1]
+    acc = None
+    for r in range(dst.shape[0]):
+        rows = _take_rows(out_e, jnp.minimum(dst[r], n - 1))
+        w = weight[r] * keep[r]
+        rows = rows * w[..., None].astype(out_e.dtype)
+        acc = rows if acc is None else acc + rows
+    return acc
+
+
+def _combine_rows_fwd(out_e, weight, dst, keep, src, valid):
+    out = _combine_rows(out_e, weight, dst, keep, src, valid)
+    return out, (out_e, weight, dst, keep, src, valid)
+
+
+def _combine_rows_bwd(res, dout):
+    out_e, weight, dst, keep, src, valid = res
+    k, _, _ = weight.shape
+    n = out_e.shape[1]
+
+    # d·out_e[b, j] = w_slot[b, j] · dout[b, src[b, j]]: recover each slot's
+    # combine weight by checking which round of its source token claimed it
+    w_slot = None
+    for r in range(k):
+        w_src = jnp.take_along_axis(weight[r] * keep[r], src, axis=1)
+        d_src = jnp.take_along_axis(dst[r], src, axis=1)
+        hit = (d_src == jnp.arange(n, dtype=jnp.int32)[None, :])
+        term = jnp.where(hit, w_src, 0.0)
+        w_slot = term if w_slot is None else w_slot + term
+    w_slot = w_slot * valid
+    d_out_e = _take_rows(dout, src) * w_slot[..., None].astype(dout.dtype)
+
+    # d·weight[r, b, s] = keep · ⟨dout[b, s], out_e[b, dst_r]⟩
+    dws = []
+    for r in range(k):
+        rows = _take_rows(out_e, jnp.minimum(dst[r], n - 1))
+        dw = jnp.sum(rows.astype(jnp.float32) * dout.astype(jnp.float32), -1)
+        dws.append(dw * keep[r])
+    dweight = jnp.stack(dws)
+
+    return (d_out_e.astype(out_e.dtype), dweight, _int_zeros(dst),
+            _int_zeros(keep), _int_zeros(src), _int_zeros(valid))
+
+
+_combine_rows.defvjp(_combine_rows_fwd, _combine_rows_bwd)
+
+
+def _expert_mlp(cfg: MoEConfig, xe, layer):
+    """The experts' SwiGLU over bucketed tokens xe (b, E, C, d)."""
+    gated = jax.nn.silu(
+        jnp.einsum("becd,edf->becf", xe, layer["w_gate"])
+    ) * jnp.einsum("becd,edf->becf", xe, layer["w_up"])
+    return jnp.einsum("becf,efd->becd", gated, layer["w_down"])
+
+
 def moe_sublayer(cfg: MoEConfig, x, layer):
     """Pre-norm routed-expert MLP + residual. x: (b, s, d) → (out, aux)."""
     b, s, d = x.shape
@@ -197,16 +380,27 @@ def moe_sublayer(cfg: MoEConfig, x, layer):
         "bsd,de->bse", y.astype(jnp.float32), layer["w_router"]
     )
     gates = jax.nn.softmax(logits, axis=-1)
-    dispatch, combine, first = _route(gates, cfg.experts_per_token, C)
 
-    # dispatch → per-expert token buckets (all-to-all over the expert axis
-    # when sharded); compute in model dtype on the MXU
-    xe = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(cfg.dtype), y)
-    gated = jax.nn.silu(
-        jnp.einsum("ebcd,edf->ebcf", xe, layer["w_gate"])
-    ) * jnp.einsum("ebcd,edf->ebcf", xe, layer["w_up"])
-    out_e = jnp.einsum("ebcf,efd->ebcd", gated, layer["w_down"])
-    out = jnp.einsum("ebcd,bsec->bsd", out_e, combine.astype(cfg.dtype))
+    if cfg.dispatch_mode == "einsum":
+        dispatch, combine, first = _route(gates, cfg.experts_per_token, C)
+        # dense one-hot dispatch → per-expert token buckets; three einsums
+        # on the MXU but O(b·s·E·C·d) flops of pure data movement
+        xe = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(cfg.dtype), y)
+        gated = jax.nn.silu(
+            jnp.einsum("ebcd,edf->ebcf", xe, layer["w_gate"])
+        ) * jnp.einsum("ebcd,edf->ebcf", xe, layer["w_up"])
+        out_e = jnp.einsum("ebcf,efd->ebcd", gated, layer["w_down"])
+        out = jnp.einsum("ebcd,bsec->bsd", out_e, combine.astype(cfg.dtype))
+    elif cfg.dispatch_mode == "gather":
+        dst, keep, weight, first = _route_plan(gates, cfg.experts_per_token, C)
+        src, valid = _slot_sources(dst, keep, cfg.n_experts * C)
+        xe = _dispatch_rows(y, src, valid, dst, keep)
+        out_e = _expert_mlp(cfg, xe.reshape(b, cfg.n_experts, C, d), layer)
+        out = _combine_rows(
+            out_e.reshape(b, cfg.n_experts * C, d), weight, dst, keep, src, valid
+        )
+    else:
+        raise ValueError(f"unknown dispatch_mode {cfg.dispatch_mode!r}")
 
     # Switch-style load-balance loss: n_experts · Σ_e f_e · P_e, where f_e
     # is the fraction of tokens whose FIRST choice is e, P_e the mean
