@@ -10,8 +10,16 @@ TPU-first decisions:
   (full-sequence forward that also emits the cache via ``lax.scan`` ys)
   followed by a ``lax.scan`` of single-token decode steps with sampling
   folded in. No Python-level token loop, no host round-trips.
-* **Cache in KV heads.** GQA caches ``n_kv_heads`` (memory ∝ kv), heads
-  are repeated at use — the broadcast folds into the attention einsum.
+* **Cache in KV heads.** GQA caches ``n_kv_heads`` (memory ∝ kv); decode
+  attention groups query heads over their KV head in the einsum itself,
+  so the cache is never materialized ``h/kv``-times wider.
+* **Cache updated in place.** The stacked (L, b, kv, S, d) cache rides the
+  layer scan's *carry* and each layer writes exactly one position with
+  ``dynamic_update_slice`` — XLA aliases the donated carry buffer, so a
+  decode step moves O(params + cache-read + one token) bytes. (Routing the
+  cache through scan xs/ys instead — the obvious structure — makes XLA
+  restack a fresh full cache every step: ~100 MB/step of pure copy at
+  llama-1b bench shapes, measured.)
 * Decode attention is plain masked dot-product against the cache (a
   single query token has no O(seq²) problem — flash buys nothing there);
   prefill reuses the training forward path (flash/Pallas on TPU).
@@ -76,27 +84,28 @@ def _mlp(cfg: ModelConfig, x: jax.Array, layer: dict) -> jax.Array:
 
 def _attend_cache(cfg, q, k_cache, v_cache, valid_len):
     """Decode-side attention only: q (b, h, 1, d) against the cache
-    (b, kv, S, d); positions ≥ valid_len masked. Prefill goes through the
-    training flash kernel instead (full-sequence causal)."""
+    (b, kv, S, d); positions ≥ valid_len masked. GQA: query heads are
+    grouped over their KV head inside the einsum (no repeated cache).
+    Prefill goes through the training flash kernel instead."""
     h, kv = cfg.n_heads, cfg.n_kv_heads
-    if kv != h:
-        rep = h // kv
-        k_cache = jnp.repeat(k_cache, rep, axis=1)
-        v_cache = jnp.repeat(v_cache, rep, axis=1)
+    b, _, _, hd = q.shape
+    rep = h // kv
+    qg = q.reshape(b, kv, rep, hd).astype(jnp.float32)       # (b, kv, rep, d)
     s = jnp.einsum(
-        "bhqd,bhkd->bhqk", q.astype(jnp.float32),
-        k_cache.astype(jnp.float32),
+        "bkrd,bksd->bkrs", qg, k_cache.astype(jnp.float32)
     ) * (1.0 / (cfg.head_dim ** 0.5))
     mask = jnp.arange(k_cache.shape[2]) < valid_len          # (S,)
     s = jnp.where(mask[None, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", p, v_cache.astype(jnp.float32))
-    return out.astype(q.dtype)
+    out = jnp.einsum("bkrs,bksd->bkrd", p, v_cache.astype(jnp.float32))
+    return out.reshape(b, h, 1, hd).astype(q.dtype)
 
 
-def _decode_block(cfg, cos, sin, pos, x, layer, k_cache, v_cache):
-    """One layer, one token. x: (b, 1, d); caches (b, kv, S, d) updated at
-    ``pos``. → (x, k_cache, v_cache)."""
+def _decode_block(cfg, cos, sin, pos, li, x, layer, k_all, v_all):
+    """One layer, one token. x: (b, 1, d); the FULL stacked cache
+    (L, b, kv, S, d) is threaded through and layer ``li``'s slice updated
+    in place at ``pos`` (one-position dynamic_update_slice on the scan
+    carry — see module docstring). → (x, k_all, v_all)."""
     b = x.shape[0]
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -108,13 +117,15 @@ def _decode_block(cfg, cos, sin, pos, x, layer, k_cache, v_cache):
     q = apply_rope(q, cos, sin, positions=positions)
     k = apply_rope(k, cos, sin, positions=positions)
 
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
+    k_all = jax.lax.dynamic_update_slice(k_all, k[None], (li, 0, 0, pos, 0))
+    v_all = jax.lax.dynamic_update_slice(v_all, v[None], (li, 0, 0, pos, 0))
+    k_cache = jax.lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)
+    v_cache = jax.lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)
 
     attn = _attend_cache(cfg, q, k_cache, v_cache, pos + 1)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, h * hd)
     x = x + attn @ layer["wo"]
-    return _mlp(cfg, x, layer), k_cache, v_cache
+    return _mlp(cfg, x, layer), k_all, v_all
 
 
 def prefill(
@@ -175,12 +186,20 @@ def decode_step(
     pos = cache.length
     x = params["embed"][token][:, None, :]                   # (b, 1, d)
 
-    def block(x, xs):
-        layer, k_c, v_c = xs
-        x, k_c, v_c = _decode_block(cfg, cos, sin, pos, x, layer, k_c, v_c)
-        return x, (k_c, v_c)
+    def block(carry, xs):
+        x, k_all, v_all = carry
+        layer, li = xs
+        x, k_all, v_all = _decode_block(
+            cfg, cos, sin, pos, li, x, layer, k_all, v_all
+        )
+        return (x, k_all, v_all), None
 
-    x, (k_new, v_new) = jax.lax.scan(block, x, (params["layers"], cache.k, cache.v))
+    n_layers = cache.k.shape[0]
+    (x, k_new, v_new), _ = jax.lax.scan(
+        block,
+        (x, cache.k, cache.v),
+        (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
+    )
 
     x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
