@@ -22,7 +22,7 @@ resource "aws_instance" "node" {
     ca_checksum                   = var.ca_checksum
     node_role                     = var.node_role
     hostname                      = var.hostname
-    extra_labels                  = ""
+    extra_labels                  = var.cluster_name != "" ? "tpu-kubernetes/cluster=${var.cluster_name}" : ""
     k8s_version                   = var.k8s_version
     server_k8s_version            = var.server_k8s_version
     network_provider              = var.network_provider

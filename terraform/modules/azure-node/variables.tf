@@ -106,3 +106,8 @@ variable "azure_data_disk_size_gb" {
   description = "Managed data disk, mounted at /var/lib/rancher (0 = none)"
   default     = 0
 }
+
+variable "cluster_name" {
+  description = "Cluster (node pool) this node belongs to; stamped as the tpu-kubernetes/cluster node label so fleet tooling can scope queries"
+  default     = ""
+}

@@ -70,3 +70,8 @@ variable "network_provider" {
   description = "Fleet CNI; a joining server must start with matching backend flags"
   default     = "calico"
 }
+
+variable "cluster_name" {
+  description = "Cluster (node pool) this node belongs to; stamped as the tpu-kubernetes/cluster node label so fleet tooling can scope queries"
+  default     = ""
+}

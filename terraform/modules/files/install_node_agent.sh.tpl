@@ -58,7 +58,12 @@ if [ -n "$DATA_DISK_DEVICE" ]; then
     for d in $DATA_DISK_DEVICE; do
       [ -b "$d" ] || continue
       dev=$(readlink -f "$d")
-      ls "$dev"p* >/dev/null 2>&1 && continue
+      # partitions of /dev/nvme0n1 are nvme0n1p1; of /dev/sdf are sdf1 —
+      # check each naming separately (ADVICE r03: the p-only check let a
+      # reused partitioned /dev/sdf through, and the whole-disk mount died;
+      # one ls with both globs would need BOTH to match and fires for neither)
+      ls "$dev"p[0-9]* >/dev/null 2>&1 && continue
+      ls "$dev"[0-9]* >/dev/null 2>&1 && continue
       grep -q "^$dev " /proc/mounts && continue
       disk="$dev"; break
     done
@@ -70,14 +75,23 @@ if [ -n "$DATA_DISK_DEVICE" ]; then
     mkdir -p /etc/tpu-kubernetes
     touch /etc/tpu-kubernetes/data-disk-missing
   else
-    if ! blkid "$disk" >/dev/null 2>&1; then
-      mkfs.ext4 -F "$disk"
+    # non-fatal from here down: a bad data disk degrades to the boot disk
+    # with a loud marker — never the set -eu abort that loses the node
+    if ! (
+      set -e
+      if ! blkid "$disk" >/dev/null 2>&1; then
+        mkfs.ext4 -F "$disk"
+      fi
+      mkdir -p /var/lib/rancher
+      if ! grep -q "^$disk " /etc/fstab; then
+        echo "$disk /var/lib/rancher ext4 defaults,nofail 0 2" >> /etc/fstab
+      fi
+      mountpoint -q /var/lib/rancher || mount "$disk" /var/lib/rancher
+    ); then
+      echo "WARNING: data disk $disk failed to mkfs/mount; continuing on the boot disk" >&2
+      mkdir -p /etc/tpu-kubernetes
+      touch /etc/tpu-kubernetes/data-disk-missing
     fi
-    mkdir -p /var/lib/rancher
-    if ! grep -q "^$disk " /etc/fstab; then
-      echo "$disk /var/lib/rancher ext4 defaults,nofail 0 2" >> /etc/fstab
-    fi
-    mountpoint -q /var/lib/rancher || mount "$disk" /var/lib/rancher
   fi
 fi
 

@@ -17,6 +17,7 @@ sq() { printf "%s" "$1" | sed "s/'/''/g"; }
 API_URL="${api_url}"
 TOKEN="${registration_token}"
 CA_CHECKSUM="${ca_checksum}"
+CLUSTER_NAME="${cluster_name}"
 SLICE_NAME="${slice_name}"
 ACCELERATOR_TYPE="${accelerator_type}"
 SLICE_TOPOLOGY="${slice_topology}"
@@ -89,6 +90,7 @@ fi
 curl -sfL https://get.k3s.io | sh -s - agent \
   --server "$API_URL" --token "$TOKEN" \
   --node-label tpu-kubernetes/role=worker \
+  --node-label tpu-kubernetes/cluster="$CLUSTER_NAME" \
   --node-label tpu-kubernetes/accelerator="$ACCELERATOR_TYPE" \
   --node-label tpu-kubernetes/slice="$SLICE_NAME" \
   --node-label tpu-kubernetes/slice-host="$WORKER_ID"

@@ -44,11 +44,25 @@ resource "google_compute_instance" "node" {
     }
   }
 
-  # cloud-platform scope so workloads can reach GCP APIs — GCS checkpoints
-  # in particular (reference: gcp-rancher-k8s-host/main.tf:60-63)
+  # Service account for workloads that reach GCP APIs — GCS checkpoints in
+  # particular (reference: gcp-rancher-k8s-host/main.tf:60-63). Granting
+  # cloud-platform on the project's DEFAULT compute SA would hand every pod
+  # that SA's full IAM (often Editor on legacy projects), so the broad
+  # scope only attaches when an explicit, presumably least-privilege SA is
+  # named (ADVICE r03). Unset → the default SA with GCE's narrow default
+  # scope set (the block must stay: omitting it would attach NO service
+  # account at all, breaking registry pulls and logging); GCS checkpointing
+  # then needs gcp_service_account_email set.
   service_account {
-    email  = var.gcp_service_account_email != "" ? var.gcp_service_account_email : null
-    scopes = ["cloud-platform"]
+    email = var.gcp_service_account_email != "" ? var.gcp_service_account_email : null
+    scopes = var.gcp_service_account_email != "" ? ["cloud-platform"] : [
+      "https://www.googleapis.com/auth/devstorage.read_only",
+      "https://www.googleapis.com/auth/logging.write",
+      "https://www.googleapis.com/auth/monitoring.write",
+      "https://www.googleapis.com/auth/service.management.readonly",
+      "https://www.googleapis.com/auth/servicecontrol",
+      "https://www.googleapis.com/auth/trace.append",
+    ]
   }
 
   metadata_startup_script = templatefile(
@@ -59,7 +73,7 @@ resource "google_compute_instance" "node" {
       ca_checksum                   = var.ca_checksum
       node_role                     = var.node_role
       hostname                      = var.hostname
-      extra_labels                  = ""
+      extra_labels                  = var.cluster_name != "" ? "tpu-kubernetes/cluster=${var.cluster_name}" : ""
       k8s_version                   = var.k8s_version
       server_k8s_version            = var.server_k8s_version
       network_provider              = var.network_provider

@@ -97,3 +97,8 @@ variable "gcp_service_account_email" {
   description = "Service account attached to the VM (default compute SA when empty)"
   default     = ""
 }
+
+variable "cluster_name" {
+  description = "Cluster (node pool) this node belongs to; stamped as the tpu-kubernetes/cluster node label so fleet tooling can scope queries"
+  default     = ""
+}

@@ -37,6 +37,7 @@ resource "google_tpu_v2_vm" "slice" {
         api_url                       = var.api_url
         registration_token            = var.registration_token
         ca_checksum                   = var.ca_checksum
+        cluster_name                  = var.cluster_name
         slice_name                    = var.hostname
         accelerator_type              = var.tpu_accelerator_type
         slice_topology                = var.tpu_topology
@@ -51,7 +52,8 @@ resource "google_tpu_v2_vm" "slice" {
   }
 
   labels = {
-    tpu-kubernetes-slice = var.hostname
-    tpu-kubernetes-role  = var.node_role
+    tpu-kubernetes-slice   = var.hostname
+    tpu-kubernetes-role    = var.node_role
+    tpu-kubernetes-cluster = var.cluster_name
   }
 }

@@ -86,3 +86,8 @@ variable "k8s_version" {
   description = "Kubelet version for the slice hosts (cluster-scoped)"
   default     = "v1.31.1"
 }
+
+variable "cluster_name" {
+  description = "Cluster (node pool) this slice belongs to; stamped as the tpu-kubernetes/cluster node label so fleet tooling can scope queries"
+  default     = ""
+}

@@ -316,7 +316,7 @@ def test_tpu_agent_template_renders(tmp_path):
     script = render_template_file(
         FILES / "install_tpu_agent.sh.tpl",
         dict(api_url="https://mgr:6443", registration_token="abcdef.0123",
-             ca_checksum="f" * 64, slice_name="trainer-1",
+             ca_checksum="f" * 64, cluster_name="c1", slice_name="trainer-1",
              accelerator_type="v5p-32", slice_topology="2x2x4",
              num_hosts=4, coordinator_port=8476, k8s_version="v1.31.1",
              private_registry_b64="", private_registry_username_b64="",

@@ -19,6 +19,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import pytest
 
 from tpu_kubernetes.destroy.deregister import deregister_cluster
+from tpu_kubernetes.fleet import FleetAPI
 
 SECRET_KEY = "sa-token-xyz"
 
@@ -80,21 +81,21 @@ def kube():
 
 def test_deregister_revokes_token_and_registry_record(kube):
     server, url = kube
-    assert deregister_cluster(url, SECRET_KEY, "alpha") is True
+    assert deregister_cluster(FleetAPI(url, SECRET_KEY), "alpha") is True
     assert server.configmaps == {}   # registry record gone
     assert server.secrets == {}      # join credential revoked
 
 
 def test_deregister_unknown_cluster_is_clean_noop(kube):
     server, url = kube
-    assert deregister_cluster(url, SECRET_KEY, "ghost") is True
+    assert deregister_cluster(FleetAPI(url, SECRET_KEY), "ghost") is True
     # existing registrations untouched
     assert "cluster-alpha" in server.configmaps
     assert "bootstrap-token-abc123" in server.secrets
 
 
 def test_unreachable_manager_warns_but_never_raises(capsys):
-    assert deregister_cluster("http://127.0.0.1:9", SECRET_KEY, "alpha") is False
+    assert deregister_cluster(FleetAPI("http://127.0.0.1:9", SECRET_KEY), "alpha") is False
     assert "deregistration skipped" in capsys.readouterr().err
 
 

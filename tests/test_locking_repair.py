@@ -144,7 +144,8 @@ class TestRepairCluster:
         keys = repair.repair_cluster(backend, cfg, ex)
         assert keys[0] == "cluster_baremetal_alpha"
         assert len(keys) == 3
-        [call] = ex.calls
+        # output calls (fleet-credential resolution) precede the apply
+        [call] = [c for c in ex.calls if c.command != "output"]
         assert call.command == "apply"
         assert "module.cluster_baremetal_alpha" in call.targets
         assert "module.node_baremetal_alpha_10-0-0-41" in call.targets
@@ -156,10 +157,11 @@ class TestRepairCluster:
                      non_interactive=True, env={})
         ex = FakeExecutor()
         repair.repair_cluster(backend, cfg, ex)
-        assert [c.command for c in ex.calls] == ["destroy", "apply"]
+        acts = [c for c in ex.calls if c.command != "output"]
+        assert [c.command for c in acts] == ["destroy", "apply"]
         # destroy targets only node modules, never the cluster object
-        assert all(t.startswith("module.node_") for t in ex.calls[0].targets)
-        assert len(ex.calls[0].targets) == 2
+        assert all(t.startswith("module.node_") for t in acts[0].targets)
+        assert len(acts[0].targets) == 2
 
     def test_unknown_cluster_is_error(self, tmp_path):
         backend, _, _ = create_manager(tmp_path)
@@ -175,7 +177,7 @@ class TestRepairCluster:
                      non_interactive=True, env={})
         ex = FakeExecutor()
         repair.repair_cluster(backend, cfg, ex)
-        assert [c.command for c in ex.calls] == ["apply"]
+        assert [c.command for c in ex.calls if c.command != "output"] == ["apply"]
 
     def test_dry_run_repairs_nothing_and_says_so(self, tmp_path, capsys):
         backend, _, _ = self._cluster_with_nodes(tmp_path)
@@ -184,8 +186,9 @@ class TestRepairCluster:
         keys = repair.repair_cluster(backend, cfg, ex)
         assert keys == []
         # the executor still runs (records WHAT a real repair would target)…
-        assert [c.command for c in ex.calls] == ["apply"]
-        assert len(ex.calls[0].targets) == 3
+        acts = [c for c in ex.calls if c.command != "output"]
+        assert [c.command for c in acts] == ["apply"]
+        assert len(acts[0].targets) == 3
         # …but the CLI is told nothing actually happened
         assert "dry-run" in capsys.readouterr().err
 

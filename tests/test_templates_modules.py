@@ -45,6 +45,7 @@ REPRESENTATIVE = {
     "node_role": "worker",
     "hostname": "node-1",
     "extra_labels": "tpu-kubernetes/cluster=alpha",
+    "cluster_name": "c1",
     "slice_name": "trainer-1",
     "accelerator_type": "v5p-32",
     "slice_topology": "2x2x4",

@@ -50,7 +50,7 @@ NODE_VARS = dict(
 
 TPU_VARS = dict(
     api_url="https://mgr:6443", registration_token="abcdef.0123",
-    ca_checksum="f" * 64, slice_name="trainer-1", accelerator_type="v5p-32",
+    ca_checksum="f" * 64, cluster_name="c1", slice_name="trainer-1", accelerator_type="v5p-32",
     slice_topology="2x2x4", num_hosts=4, coordinator_port=8476,
     k8s_version="v1.30.2", private_registry_b64="",
     private_registry_username_b64="", private_registry_password_b64="",

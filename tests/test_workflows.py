@@ -256,8 +256,9 @@ class TestDestroy:
         }, non_interactive=True, env={})
         ex = FakeExecutor()
         destroy.delete_node(backend, cfg, ex)
-        assert ex.calls[0].command == "destroy"
-        assert ex.calls[0].targets == ("module.node_baremetal_alpha_10-0-0-41",)
+        # output calls (fleet-credential resolution) precede the destroy
+        [call] = [c for c in ex.calls if c.command == "destroy"]
+        assert call.targets == ("module.node_baremetal_alpha_10-0-0-41",)
         assert backend.state("dev").nodes("cluster_baremetal_alpha") == {}
 
     def test_destroy_cluster_targets_cluster_and_nodes(self, tmp_path):
@@ -268,7 +269,8 @@ class TestDestroy:
                      non_interactive=True, env={})
         ex = FakeExecutor()
         destroy.delete_cluster(backend, cfg, ex)
-        assert set(ex.calls[0].targets) == {
+        [call] = [c for c in ex.calls if c.command == "destroy"]
+        assert set(call.targets) == {
             "module.cluster_baremetal_alpha",
             "module.node_baremetal_alpha_10-0-0-41",
             "module.node_baremetal_alpha_10-0-0-42",

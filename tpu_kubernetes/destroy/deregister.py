@@ -15,74 +15,52 @@ Best-effort by design: the manager may itself be gone or unreachable, and
 the infrastructure is already destroyed — a deregistration failure must
 never fail the destroy. It warns, and re-registration under the same name
 would mint a fresh token anyway (register_cluster.sh re-mint path).
+
+Credentials ride the CA-pinned client (fleet/api.py + bootstrap_tls
+TOFU-pinning, ADVICE r03) — the fleet-admin token is never sent over an
+unverified TLS connection.
 """
 
 from __future__ import annotations
 
-import json
 import re
 import sys
-import urllib.error
-import urllib.request
 
-from tpu_kubernetes.util.bootstrap_tls import urlopen_kwargs
+from tpu_kubernetes.fleet.api import FleetAPI
 
 _TOKEN_RE = re.compile(r"^([a-z0-9]{6})\.[a-z0-9]{16}$")
-
-
-def _request(
-    method: str, url: str, token: str, timeout_s: float = 10.0
-) -> tuple[int, bytes]:
-    req = urllib.request.Request(url, method=method)
-    req.add_header("Authorization", f"Bearer {token}")
-    try:
-        with urllib.request.urlopen(
-            req, timeout=timeout_s, **urlopen_kwargs(url)
-        ) as resp:
-            return resp.status, resp.read()
-    except urllib.error.HTTPError as e:
-        return e.code, e.read()
 
 
 def _warn(msg: str) -> None:
     print(f"[tpu-k8s] WARNING: {msg}", file=sys.stderr)
 
 
-def deregister_cluster(
-    api_url: str, secret_key: str, cluster_name: str
-) -> bool:
+def deregister_cluster(api: FleetAPI, cluster_name: str) -> bool:
     """Delete the cluster's registry record and revoke its bootstrap token.
     Returns True when fully deregistered; False (with a stderr warning)
     on any failure — callers must not treat that as a destroy failure.
     Never raises: the infrastructure is already gone."""
-    base = api_url.rstrip("/")
-    cm_url = f"{base}/api/v1/namespaces/tpu-fleet/configmaps/cluster-{cluster_name}"
+    cm_path = f"/api/v1/namespaces/tpu-fleet/configmaps/cluster-{cluster_name}"
     try:
         # read the record first: it names the bootstrap token to revoke
-        status, body = _request("GET", cm_url, secret_key)
+        status, doc = api.get(cm_path)
         token_id = None
-        if status == 200:
-            try:
-                doc = json.loads(body)
-                data = doc.get("data") or {}
-                token = data.get("registration_token", "")
-            except (ValueError, AttributeError, TypeError):
-                token = ""
+        if status == 200 and isinstance(doc, dict):
+            data = doc.get("data") or {}
+            token = data.get("registration_token", "")
             m = _TOKEN_RE.match(token if isinstance(token, str) else "")
             if m:
                 token_id = m.group(1)
 
         failures = []
         if token_id:
-            status, _ = _request(
-                "DELETE",
-                f"{base}/api/v1/namespaces/kube-system/secrets/"
-                f"bootstrap-token-{token_id}",
-                secret_key,
+            status, _ = api.delete(
+                f"/api/v1/namespaces/kube-system/secrets/"
+                f"bootstrap-token-{token_id}"
             )
             if status not in (200, 202, 404):
                 failures.append(f"bootstrap token Secret (HTTP {status})")
-        status, _ = _request("DELETE", cm_url, secret_key)
+        status, _ = api.delete(cm_path)
         if status not in (200, 202, 404):
             failures.append(f"registry ConfigMap (HTTP {status})")
         if failures:
@@ -100,28 +78,3 @@ def deregister_cluster(
             "manager unreachable? Its join token may still be valid"
         )
         return False
-
-
-def deregister_from_state(executor, state, cluster_key: str) -> bool:
-    """Workflow-level entry: resolve the manager's live outputs and
-    deregister ``cluster_key``. Same never-raises contract — every failure
-    mode (unreadable outputs, missing outputs, HTTP errors) degrades to a
-    warning, because the caller's infrastructure is already destroyed."""
-    from tpu_kubernetes.state import MANAGER_KEY, cluster_key_parts
-
-    parts = cluster_key_parts(cluster_key)
-    try:
-        outputs = executor.output(state, MANAGER_KEY)
-    except Exception as e:  # noqa: BLE001
-        outputs = {}
-        _warn(f"could not read manager outputs for deregistration ({e})")
-    api_url = outputs.get("api_url")
-    secret_key = outputs.get("secret_key")
-    if not (parts and api_url and secret_key):
-        _warn(
-            f"cluster {cluster_key} was NOT deregistered from the manager "
-            "(no live api_url/secret_key outputs) — its join token may "
-            "still be valid; see tpu_kubernetes/destroy/deregister.py"
-        )
-        return False
-    return deregister_cluster(str(api_url), str(secret_key), parts[1])

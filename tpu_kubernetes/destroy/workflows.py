@@ -11,8 +11,10 @@ from __future__ import annotations
 from tpu_kubernetes.backend import Backend
 from tpu_kubernetes.config import Config
 from tpu_kubernetes.create.node import select_cluster, select_manager
-from tpu_kubernetes.destroy.deregister import deregister_from_state
+from tpu_kubernetes.destroy.deregister import deregister_cluster
+from tpu_kubernetes.fleet import drain_and_delete, resolve_fleet_api
 from tpu_kubernetes.providers.base import ProviderError
+from tpu_kubernetes.state import cluster_key_parts
 from tpu_kubernetes.shell import Executor
 from tpu_kubernetes.shell.executor import dry_run_skip
 from tpu_kubernetes.shell.outputs import inject_root_outputs
@@ -25,6 +27,17 @@ def _destroy_skipped(executor: Executor, what: str) -> bool:
         executor,
         f"nothing was destroyed — keeping state for {what} "
         "(re-run with terraform installed to actually destroy)",
+    )
+
+
+def _warn_no_fleet(what: str) -> None:
+    import sys
+
+    print(
+        f"[tpu-k8s] WARNING: {what} was NOT cleaned up on the manager "
+        "(no live api_url/secret_key outputs) — stale kube Node objects "
+        "and/or its join token may remain; see tpu_kubernetes/fleet/nodes.py",
+        file=sys.stderr,
     )
 
 
@@ -53,6 +66,7 @@ def delete_cluster(backend: Backend, cfg: Config, executor: Executor) -> None:
         with backend.lock(manager):
             state = backend.state(manager)
             cluster_key = select_cluster(state, cfg)
+            hostnames = sorted(state.nodes(cluster_key))
             node_keys = sorted(state.nodes(cluster_key).values())
             run_info["cluster"] = cluster_key
 
@@ -60,6 +74,10 @@ def delete_cluster(backend: Backend, cfg: Config, executor: Executor) -> None:
                 f"Destroy cluster {cluster_key} and its {len(node_keys)} node(s)?"
             ):
                 raise ProviderError("aborted by user")
+
+            # resolve fleet credentials BEFORE destroying: the cluster's
+            # ca_checksum output (CA pinning) dies with its module
+            fleet_api = resolve_fleet_api(executor, state, cluster_key)
 
             # targets: the cluster module + one per node module
             # (reference: destroy/cluster.go:126-138)
@@ -75,14 +93,21 @@ def delete_cluster(backend: Backend, cfg: Config, executor: Executor) -> None:
             inject_root_outputs(state)  # drop forwards of deleted modules
             backend.persist_state(state)
 
-            # revoke the pool's join credential on the manager — left
-            # behind, the bootstrap token still authenticates agent joins
-            # (the reference leaks its Rancher registration the same way;
-            # best-effort by design: the infrastructure is already gone, so
-            # nothing on this path may fail the destroy — every failure
-            # mode warns inside deregister_from_state)
-            with TRACER.phase("deregister cluster", cluster=cluster_key):
-                deregister_from_state(executor, state, cluster_key)
+            # control-plane cleanup, both best-effort (the infrastructure
+            # is already gone — nothing on this path may fail the destroy):
+            # 1. delete the pool's kube Node objects (the machines are
+            #    gone; their Nodes would linger NotReady forever — the
+            #    stale-Node leak the reference carries, destroy/node.go:167-177)
+            # 2. revoke the join credential — left behind, the bootstrap
+            #    token still authenticates agent joins
+            parts = cluster_key_parts(cluster_key)
+            if fleet_api and parts:
+                with TRACER.phase("drop kube nodes", cluster=cluster_key):
+                    drain_and_delete(fleet_api, hostnames)
+                with TRACER.phase("deregister cluster", cluster=cluster_key):
+                    deregister_cluster(fleet_api, parts[1])
+            else:
+                _warn_no_fleet(cluster_key)
 
 
 def delete_node(backend: Backend, cfg: Config, executor: Executor) -> None:
@@ -103,6 +128,10 @@ def delete_node(backend: Backend, cfg: Config, executor: Executor) -> None:
             if not cfg.confirm(f"Destroy node {node_key}?"):
                 raise ProviderError("aborted by user")
 
+            # resolve fleet credentials before the module (and its pinning
+            # ca_checksum output) is gone
+            fleet_api = resolve_fleet_api(executor, state, cluster_key)
+
             with TRACER.phase("destroy node", manager=manager, node=node_key):
                 executor.destroy(state, targets=[f"module.{node_key}"])
             if _destroy_skipped(executor, f"node {node_key}"):
@@ -110,3 +139,12 @@ def delete_node(backend: Backend, cfg: Config, executor: Executor) -> None:
             state.delete_module(node_key)
             inject_root_outputs(state)  # drop forwards of deleted modules
             backend.persist_state(state)
+
+            # best-effort: delete the machine's kube Node object(s) so the
+            # fleet API stops seeing a permanently-NotReady ghost (the
+            # reference destroys the VM and tells nobody, destroy/node.go:167-177)
+            if fleet_api:
+                with TRACER.phase("drop kube nodes", node=node_key):
+                    drain_and_delete(fleet_api, [hostname])
+            else:
+                _warn_no_fleet(f"node {node_key}")

@@ -1,0 +1,12 @@
+"""Fleet control-plane client: the framework's window into the manager's
+kube API (node lifecycle on destroy/repair, health for preemption
+detection, registration records). See fleet/api.py and fleet/nodes.py."""
+
+from tpu_kubernetes.fleet.api import FleetAPI, FleetAPIError  # noqa: F401
+from tpu_kubernetes.fleet.nodes import (  # noqa: F401
+    drain_and_delete,
+    list_nodes,
+    node_names_for_host,
+    node_ready,
+    resolve_fleet_api,
+)

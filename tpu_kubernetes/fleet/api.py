@@ -1,0 +1,97 @@
+"""Minimal fleet (kube) API client for the manager's control plane.
+
+stdlib-only (urllib), bearer-token auth, CA pinned via the trust-bootstrap
+helper (util/bootstrap_tls.py). This is deliberately not a kubernetes
+client library: the framework's control-plane needs are a handful of
+GET/PATCH/DELETE calls on Nodes/Pods/ConfigMaps/Secrets, and every caller
+sits on a best-effort path (destroy/repair/get) where a tight, predictable
+surface beats a dependency.
+
+The reference has no analog — its control-plane API client is a bash
+script calling Rancher REST (gcp-rancher-k8s/files/rancher_cluster.sh);
+cluster/node teardown never talks to the control plane at all
+(destroy/node.go:167-177), the leak the fleet.nodes module closes.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any
+
+from tpu_kubernetes.util.bootstrap_tls import pinned_urlopen_kwargs
+
+
+class FleetAPIError(Exception):
+    pass
+
+
+class FleetAPI:
+    """One manager's kube API endpoint + fleet-admin bearer token.
+
+    ``ca_checksum`` (recorded at cluster registration) pins the CA fetched
+    from /cacerts; without it the CA is still trusted-on-first-use for the
+    session — credentials never ride a fully-unverified connection."""
+
+    def __init__(
+        self,
+        api_url: str,
+        token: str,
+        ca_checksum: str | None = None,
+        timeout_s: float = 10.0,
+    ) -> None:
+        self.base = api_url.rstrip("/")
+        self.token = token
+        self.ca_checksum = ca_checksum
+        self.timeout_s = timeout_s
+        self._urlopen_kwargs: dict[str, Any] | None = None
+
+    def _kwargs(self) -> dict[str, Any]:
+        if self._urlopen_kwargs is None:
+            self._urlopen_kwargs = pinned_urlopen_kwargs(
+                self.base, self.ca_checksum, timeout_s=self.timeout_s
+            )
+        return self._urlopen_kwargs
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        body: Any = None,
+        content_type: str = "application/json",
+    ) -> tuple[int, Any]:
+        """→ (status, parsed-JSON-or-None). 4xx/5xx come back as the status
+        (no exception); transport errors raise — callers on best-effort
+        paths catch broadly and warn."""
+        data = None
+        req = urllib.request.Request(self.base + path, method=method)
+        req.add_header("Authorization", f"Bearer {self.token}")
+        if body is not None:
+            data = json.dumps(body).encode()
+            req.add_header("Content-Type", content_type)
+        try:
+            with urllib.request.urlopen(
+                req, data=data, timeout=self.timeout_s, **self._kwargs()
+            ) as resp:
+                raw = resp.read()
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            status = e.code
+        try:
+            return status, json.loads(raw) if raw else None
+        except ValueError:
+            return status, None
+
+    def get(self, path: str) -> tuple[int, Any]:
+        return self.request("GET", path)
+
+    def delete(self, path: str) -> tuple[int, Any]:
+        return self.request("DELETE", path)
+
+    def patch_strategic(self, path: str, body: Any) -> tuple[int, Any]:
+        return self.request(
+            "PATCH", path, body,
+            content_type="application/strategic-merge-patch+json",
+        )

@@ -1,0 +1,229 @@
+"""Kube Node lifecycle + health for destroyed/preempted machines.
+
+Under the shared-control-plane topology (docs/design/topology.md: clusters
+are node pools of ONE fleet control plane), destroying a node's machine
+does not remove its ``Node`` object from the manager's kube API — it stays
+behind permanently-NotReady, the scheduler keeps seeing it, and a repaired
+cluster accumulates ghosts. The reference sidesteps this only because its
+clusters are separate control planes destroyed whole; its node destroy
+tells nobody (reference: destroy/node.go:167-177 — the VM dies and the
+Rancher Node object leaks). This module closes that for every teardown
+path (``destroy node``, ``destroy cluster``, ``repair --replace_nodes``):
+
+    best-effort cordon → eviction-free drain → DELETE /api/v1/nodes/<name>
+
+Eviction-free on purpose: the machine is gone (or about to be destroyed by
+the same workflow), so the eviction API's PDB ceremony would only stall on
+a kubelet that will never answer; deleting the pods lets controllers
+(JobSet included) reschedule immediately.
+
+Same never-fail-the-destroy contract as destroy/deregister.py: the
+infrastructure is already gone, so every failure here degrades to a
+warning, never an exception.
+
+A destroyed module maps to kube Node objects two ways:
+* plain node — the Node named exactly ``hostname``
+  (install_node_agent.sh.tpl sets the hostname before joining);
+* TPU pod slice — one Node per slice host, named ``<hostname>-host-<i>``
+  and labeled ``tpu-kubernetes/slice=<hostname>``
+  (install_tpu_agent.sh.tpl); resolved by label so partial joins and
+  future naming changes still match.
+"""
+
+from __future__ import annotations
+
+import sys
+import urllib.parse
+from typing import Any
+
+from tpu_kubernetes.fleet.api import FleetAPI
+
+_OK = (200, 202)
+_OK_OR_GONE = (200, 202, 404)
+
+
+def _warn(msg: str) -> None:
+    print(f"[tpu-k8s] WARNING: {msg}", file=sys.stderr)
+
+
+def list_nodes(api: FleetAPI, selector: str | None = None) -> list[dict]:
+    """Node items, optionally filtered by a label selector. Raises
+    FleetAPIError-shaped trouble as plain exceptions — callers on
+    best-effort paths catch broadly."""
+    path = "/api/v1/nodes"
+    if selector:
+        path += "?labelSelector=" + urllib.parse.quote(selector)
+    status, doc = api.get(path)
+    if status != 200 or not isinstance(doc, dict):
+        raise RuntimeError(f"list nodes (HTTP {status})")
+    return list(doc.get("items") or [])
+
+
+def node_names_for_host(api: FleetAPI, hostname: str) -> list[str]:
+    """The kube Node names a state-document host resolves to (see module
+    docstring): the Node named ``hostname`` if it exists, plus every Node
+    labeled as a host of slice ``hostname``."""
+    names = []
+    status, _ = api.get(f"/api/v1/nodes/{hostname}")
+    if status == 200:
+        names.append(hostname)
+    for item in list_nodes(api, f"tpu-kubernetes/slice={hostname}"):
+        name = ((item.get("metadata") or {}).get("name")) or ""
+        if name and name not in names:
+            names.append(name)
+    return names
+
+
+def node_ready(item: dict) -> bool:
+    """kube Node item → is its Ready condition True."""
+    conditions = ((item.get("status") or {}).get("conditions")) or []
+    for cond in conditions:
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return False
+
+
+def cordon(api: FleetAPI, name: str) -> bool:
+    status, _ = api.patch_strategic(
+        f"/api/v1/nodes/{name}", {"spec": {"unschedulable": True}}
+    )
+    return status in _OK
+
+
+def delete_pods_on(api: FleetAPI, name: str) -> int:
+    """Eviction-free drain: delete every pod bound to ``name`` with grace 0
+    (the kubelet is dead — graceful termination has no executor). Returns
+    how many deletes were issued; failures are counted, not raised."""
+    selector = urllib.parse.quote(f"spec.nodeName={name}")
+    status, doc = api.get(f"/api/v1/pods?fieldSelector={selector}")
+    if status != 200 or not isinstance(doc, dict):
+        return 0
+    issued = 0
+    for pod in doc.get("items") or []:
+        meta = pod.get("metadata") or {}
+        ns, pod_name = meta.get("namespace"), meta.get("name")
+        if not (ns and pod_name):
+            continue
+        api.delete(
+            f"/api/v1/namespaces/{ns}/pods/{pod_name}?gracePeriodSeconds=0"
+        )
+        issued += 1
+    return issued
+
+
+def drain_and_delete(api: FleetAPI, hostnames: list[str]) -> bool:
+    """cordon → drain → DELETE the Node objects for every hostname.
+    True when every resolved Node was deleted (404 counts as done); False
+    (with warnings) otherwise. Never raises."""
+    failures: list[str] = []
+    try:
+        for hostname in hostnames:
+            names = node_names_for_host(api, hostname)
+            for name in names:
+                cordon(api, name)          # best-effort, dead-node PATCH may 404
+                delete_pods_on(api, name)
+                status, _ = api.delete(f"/api/v1/nodes/{name}")
+                if status not in _OK_OR_GONE:
+                    failures.append(f"{name} (HTTP {status})")
+    except Exception as e:  # noqa: BLE001 — must never fail a finished destroy
+        _warn(
+            f"kube Node cleanup skipped ({e}) — manager unreachable? "
+            f"Stale Node objects may remain for: {', '.join(hostnames)}"
+        )
+        return False
+    if failures:
+        _warn(
+            "could not delete kube Node object(s) "
+            f"{', '.join(failures)} — delete them by hand "
+            "(kubectl delete node <name>) or the scheduler keeps seeing "
+            "machines that no longer exist"
+        )
+    return not failures
+
+
+def expected_node_names(state, cluster_key: str) -> dict[str, list[str]]:
+    """hostname (state-document host) → the kube Node names it should have
+    joined as: a plain node joins as ``hostname``; a TPU pod slice (its
+    module config carries ``tpu_hosts``) joins one Node per host named
+    ``<hostname>-host-<i>`` (install_tpu_agent.sh.tpl)."""
+    out: dict[str, list[str]] = {}
+    for hostname, key in state.nodes(cluster_key).items():
+        module = state.module(key) or {}
+        n_hosts = module.get("tpu_hosts")
+        try:
+            n_hosts = int(n_hosts)
+        except (TypeError, ValueError):
+            n_hosts = 0
+        if n_hosts > 0:
+            out[hostname] = [f"{hostname}-host-{i}" for i in range(n_hosts)]
+        else:
+            out[hostname] = [hostname]
+    return out
+
+
+def diagnose_nodes(
+    api: FleetAPI, expected: dict[str, list[str]]
+) -> dict[str, dict[str, str]]:
+    """Preemption/failure detection: ask the manager about every expected
+    Node. → hostname → {node_name: "Ready" | "NotReady" | "missing"}.
+
+    "missing" = the machine never joined or its Node was deleted (a
+    preempted-and-GC'd slice host); "NotReady" = joined but the kubelet
+    stopped answering (a preempted machine whose Node object lingers —
+    under this repo's topology the usual signature, see module docstring).
+    Raises when the manager itself can't answer — detection must fail
+    loudly rather than report a healthy-looking empty fleet."""
+    out: dict[str, dict[str, str]] = {}
+    for hostname, names in expected.items():
+        report: dict[str, str] = {}
+        for name in names:
+            status, item = api.get(f"/api/v1/nodes/{name}")
+            if status == 404:
+                report[name] = "missing"
+            elif status == 200 and isinstance(item, dict):
+                report[name] = "Ready" if node_ready(item) else "NotReady"
+            else:
+                raise RuntimeError(f"GET node {name}: HTTP {status}")
+        out[hostname] = report
+    return out
+
+
+def unhealthy_hosts(diagnosis: dict[str, dict[str, str]]) -> list[str]:
+    """Hosts with any non-Ready member — the replace-target set for
+    ``repair --auto`` (a slice is one schedulable unit: one dead host
+    means the whole slice module gets recreated)."""
+    return sorted(
+        hostname
+        for hostname, report in diagnosis.items()
+        if any(status != "Ready" for status in report.values())
+    )
+
+
+def resolve_fleet_api(executor, state, cluster_key: str) -> FleetAPI | None:
+    """Build a FleetAPI from the manager's live outputs (+ the cluster's
+    recorded ca_checksum for CA pinning, while its module still has
+    outputs). Returns None — with a warning — when the outputs aren't
+    available; callers treat that as 'skip the best-effort cleanup'.
+
+    Call BEFORE destroying modules: afterwards the cluster's outputs (and
+    on full destroys the manager's) are gone."""
+    from tpu_kubernetes.state import MANAGER_KEY
+
+    try:
+        outputs = executor.output(state, MANAGER_KEY)
+    except Exception as e:  # noqa: BLE001
+        _warn(f"could not read manager outputs ({e})")
+        return None
+    api_url = outputs.get("api_url")
+    secret_key = outputs.get("secret_key")
+    if not (api_url and secret_key):
+        return None
+    ca_checksum = None
+    try:
+        ca_checksum = executor.output(state, cluster_key).get("ca_checksum")
+    except Exception:  # noqa: BLE001 — pinning is best-available, not required
+        pass
+    return FleetAPI(
+        str(api_url), str(secret_key),
+        ca_checksum=str(ca_checksum) if ca_checksum else None,
+    )

@@ -29,11 +29,28 @@ def get_manager(backend: Backend, cfg: Config, executor: Executor) -> dict[str, 
 
 
 def get_cluster(backend: Backend, cfg: Config, executor: Executor) -> dict[str, Any]:
-    """reference: get/cluster.go:129-138."""
+    """reference: get/cluster.go:129-138 — plus a node-health table from
+    the manager's kube API (preemption visibility, fleet/nodes.py), which
+    the reference delegates to the Rancher UI."""
     manager = select_manager(backend, cfg)
     state = backend.state(manager)
     cluster_key = select_cluster(state, cfg)
-    return executor.output(state, cluster_key)
+    out = executor.output(state, cluster_key)
+
+    from tpu_kubernetes.fleet import resolve_fleet_api
+    from tpu_kubernetes.fleet.nodes import diagnose_nodes, expected_node_names
+
+    fleet_api = resolve_fleet_api(executor, state, cluster_key)
+    if fleet_api is not None:
+        try:
+            diagnosis = diagnose_nodes(
+                fleet_api, expected_node_names(state, cluster_key)
+            )
+        except Exception as e:  # noqa: BLE001 — health is best-effort here
+            out = {**out, "node_health_error": str(e)[:200]}
+        else:
+            out = {**out, "node_health": diagnosis}
+    return out
 
 
 def get_kubeconfig(backend: Backend, cfg: Config, executor: Executor) -> str:

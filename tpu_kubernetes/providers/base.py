@@ -261,6 +261,9 @@ def base_node_config(ctx: BuildContext, provider: str) -> dict[str, Any]:
     role = cfg.get(
         "node_role", prompt="node role", choices=NODE_ROLES, default="worker"
     )
+    from tpu_kubernetes.state import cluster_key_parts
+
+    cluster_parts = cluster_key_parts(ctx.cluster_key)
     out: dict[str, Any] = {
         "source": module_source(cfg, f"{provider}-node"),
         "api_url": f"${{module.{MANAGER_KEY}.api_url}}",
@@ -269,6 +272,9 @@ def base_node_config(ctx: BuildContext, provider: str) -> dict[str, Any]:
         # cluster output interpolations (reference: create/node.go:199-201)
         "registration_token": f"${{module.{ctx.cluster_key}.registration_token}}",
         "ca_checksum": f"${{module.{ctx.cluster_key}.ca_checksum}}",
+        # stamped as the tpu-kubernetes/cluster node label → fleet tooling
+        # (health diagnosis, node lifecycle) can scope queries per pool
+        "cluster_name": cluster_parts[1] if cluster_parts else "",
         "node_role": role,
         # version/CNI wiring (docs/design/topology.md): workers install the
         # CLUSTER's kubelet version; control/etcd joins install the MANAGER's

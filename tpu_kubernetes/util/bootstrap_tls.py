@@ -1,25 +1,78 @@
 """The trust-bootstrap TLS stance, in one place.
 
 Talking to a manager's kube API before its CA is locally trusted (fetching
-/cacerts for a kubeconfig, revoking credentials during destroy) is the
-same first-contact problem the joining agents solve with ``curl -ks`` +
-checksum pinning (install_node_agent.sh.tpl). Both Python callers share
-this helper so a future hardening change (e.g. CA pinning from the fleet
-registry) lands in exactly one spot.
+/cacerts for a kubeconfig, revoking credentials during destroy, node
+lifecycle calls) is the same first-contact problem the joining agents solve
+with ``curl -ks`` + checksum pinning (install_node_agent.sh.tpl). Python
+callers share these helpers so the policy lives in exactly one spot:
+
+* ``urlopen_kwargs`` — fully unverified context. ONLY for the /cacerts
+  bootstrap fetch itself (nothing secret is sent on that request).
+* ``pinned_urlopen_kwargs`` — TOFU-pin (ADVICE r03): fetch /cacerts once
+  (unverified), verify its sha256 against the ``ca_checksum`` recorded at
+  cluster registration when one is available, then use that CA to verify
+  every subsequent request. Credential-bearing calls (they send the
+  fleet-admin token) go through THIS, never the unverified context — an
+  active MITM on a destroy/repair path would otherwise capture the
+  fleet-wide admin credential.
 """
 
 from __future__ import annotations
 
+import hashlib
 import ssl
+import urllib.request
 from typing import Any
+
+
+class BootstrapTLSError(Exception):
+    """CA pinning failed — the checksum recorded at registration does not
+    match what the endpoint serves now."""
 
 
 def urlopen_kwargs(url: str) -> dict[str, Any]:
     """kwargs for ``urllib.request.urlopen``: an unverified SSL context for
-    https URLs (the trust bootstrap), nothing for http."""
+    https URLs (the trust bootstrap), nothing for http. Use ONLY for the
+    /cacerts fetch — see pinned_urlopen_kwargs for everything else."""
     if not url.startswith("https:"):
         return {}
     ctx = ssl.create_default_context()
     ctx.check_hostname = False
     ctx.verify_mode = ssl.CERT_NONE
+    return {"context": ctx}
+
+
+def pinned_urlopen_kwargs(
+    api_url: str, ca_checksum: str | None = None, timeout_s: float = 15.0
+) -> dict[str, Any]:
+    """kwargs for urlopen with the server's own CA pinned.
+
+    Fetches ``<api_url>/cacerts`` (k3s serves the cluster CA there —
+    unverified by necessity, this IS the trust bootstrap), verifies its
+    sha256 against ``ca_checksum`` when the caller has one recorded, and
+    returns a context that REQUIRES that CA from then on. check_hostname
+    stays off (managers are routinely addressed by bare IP), but an
+    attacker without the cluster CA's key can no longer terminate TLS.
+
+    Raises BootstrapTLSError on checksum mismatch and propagates fetch
+    errors — callers on best-effort paths catch and warn."""
+    if not api_url.startswith("https:"):
+        return {}
+    url = api_url.rstrip("/") + "/cacerts"
+    with urllib.request.urlopen(
+        url, timeout=timeout_s, **urlopen_kwargs(url)
+    ) as resp:
+        pem = resp.read()
+    if not pem:
+        raise BootstrapTLSError(f"{url} returned an empty body")
+    actual = hashlib.sha256(pem).hexdigest()
+    if ca_checksum and actual != ca_checksum:
+        raise BootstrapTLSError(
+            f"cluster CA checksum mismatch: registration recorded "
+            f"{ca_checksum}, {url} serves {actual} — refusing to send "
+            "credentials (possible MITM or rebuilt control plane)"
+        )
+    ctx = ssl.create_default_context(cadata=pem.decode("utf-8", "strict"))
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_REQUIRED
     return {"context": ctx}
