@@ -201,7 +201,15 @@ class S3Store(ObjectStore):
         ignore the unknown header and answer 200 — both lock contenders
         would then 'win', which is precisely the Manta no-locking gap
         (reference: backend/manta/backend.go:32) this backend closes."""
-        probe = ".tpu-kubernetes-conditional-write-probe"
+        # unique per process and under our prefix (ADVICE r03): a shared
+        # fixed key let two CLIs verifying concurrently interleave (A's
+        # DELETE between B's two PUTs → spurious 'endpoint does not honor
+        # conditional writes'); a per-process key races only against itself
+        import uuid
+
+        probe = (
+            "tpu-kubernetes/.conditional-write-probe-" + uuid.uuid4().hex
+        )
         self._request("PUT", probe, payload=b"probe")
         status, _ = self._request(
             "PUT", probe, payload=b"probe2",
