@@ -1,0 +1,116 @@
+"""OPT-IN end-to-end smoke against a REAL k3s control plane.
+
+Every layer of the bootstrap chain is executed hermetically elsewhere
+(tests/test_bootstrap_exec.py runs the rendered scripts against stubbed
+k3s/curl; tests/test_fleet_nodes.py drives workflows against a fake kube
+API). This test closes the last fake-vs-real gap (SURVEY §4: "a
+single-host 'baremetal local' path usable as an e2e smoke test"): the
+rendered manager bootstrap runs with REAL binaries, boots a real k3s
+server on this host, and the framework's own client path — kubeconfig
+synthesis from /cacerts + the fleet-admin token, then a FleetAPI node
+listing — is verified against it.
+
+Gated hard: requires ``TPU_K8S_E2E=1`` (it installs k3s system-wide via
+systemd and uninstalls it afterwards — never run it on a machine you
+care about) plus either a ``k3s`` binary on PATH or network access to
+get.k3s.io. CI and the default suite always skip it.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import socket
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+from tests.test_bootstrap_exec import manager_script
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("TPU_K8S_E2E") != "1",
+    reason="opt-in real-k3s e2e: set TPU_K8S_E2E=1 (installs k3s on THIS host)",
+)
+
+
+def _k3s_obtainable() -> bool:
+    if shutil.which("k3s"):
+        return True
+    try:
+        socket.create_connection(("get.k3s.io", 443), timeout=3).close()
+        return True
+    except OSError:
+        return False
+
+
+API_URL = "https://127.0.0.1:6443"
+
+
+def test_real_k3s_end_to_end(tmp_path):
+    if not _k3s_obtainable():
+        pytest.skip("no k3s binary and no route to get.k3s.io")
+    if os.geteuid() != 0:
+        pytest.skip("k3s server bootstrap needs root")
+
+    # flannel: k3s's built-in CNI — no baked manifest required
+    script = manager_script(network_provider="flannel")
+    path = tmp_path / "bootstrap.sh"
+    path.write_text(script)
+    try:
+        proc = subprocess.run(
+            ["sh", str(path)], capture_output=True, text=True, timeout=600,
+        )
+        assert proc.returncode == 0, (
+            f"manager bootstrap failed:\n{proc.stdout[-2000:]}\n"
+            f"{proc.stderr[-2000:]}"
+        )
+
+        # the bootstrap minted and published the fleet-admin token
+        token = Path("/etc/tpu-kubernetes/api_secret_key").read_text().strip()
+        assert token
+
+        # framework client path: CA bootstrap → kubeconfig synthesis
+        from tpu_kubernetes.get.kubeconfig import build_kubeconfig, fetch_ca_pem
+
+        ca_pem = fetch_ca_pem(API_URL)
+        kubeconfig = build_kubeconfig("e2e", API_URL, token, ca_pem)
+        assert "certificate-authority-data" in kubeconfig
+        (tmp_path / "kubeconfig").write_text(kubeconfig)
+
+        # and the fleet API client (CA TOFU-pinned) sees the manager node
+        from tpu_kubernetes.fleet import FleetAPI, list_nodes
+        from tpu_kubernetes.fleet.nodes import node_ready
+
+        api = FleetAPI(API_URL, token, timeout_s=15.0)
+        deadline = time.monotonic() + 180
+        nodes = []
+        while time.monotonic() < deadline:
+            try:
+                nodes = list_nodes(api)
+            except Exception:
+                nodes = []
+            if nodes and all(node_ready(n) for n in nodes):
+                break
+            time.sleep(5)
+        assert nodes, "no nodes visible through the fleet API"
+        assert all(node_ready(n) for n in nodes), (
+            f"manager node never became Ready: {nodes}"
+        )
+        labels = (nodes[0].get("metadata") or {}).get("labels") or {}
+        assert labels.get("tpu-kubernetes/role") == "manager"
+
+        # kubectl parity when available: the synthesized kubeconfig works
+        if shutil.which("kubectl"):
+            out = subprocess.run(
+                ["kubectl", "--kubeconfig", str(tmp_path / "kubeconfig"),
+                 "get", "nodes", "--no-headers"],
+                capture_output=True, text=True, timeout=60,
+            )
+            assert out.returncode == 0, out.stderr
+            assert "Ready" in out.stdout
+    finally:
+        uninstall = shutil.which("k3s-uninstall.sh") or "/usr/local/bin/k3s-uninstall.sh"
+        if os.path.exists(uninstall):
+            subprocess.run([uninstall], capture_output=True, timeout=300)

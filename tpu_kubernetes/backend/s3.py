@@ -204,18 +204,22 @@ class S3Store(ObjectStore):
         # unique per process and under our prefix (ADVICE r03): a shared
         # fixed key let two CLIs verifying concurrently interleave (A's
         # DELETE between B's two PUTs → spurious 'endpoint does not honor
-        # conditional writes'); a per-process key races only against itself
+        # conditional writes'); a per-process key races only against itself.
+        # try/finally: a transient error on the conditional PUT must not
+        # orphan the probe object in the state prefix
         import uuid
 
-        probe = (
-            "tpu-kubernetes/.conditional-write-probe-" + uuid.uuid4().hex
-        )
+        from tpu_kubernetes.backend.objectstore import PREFIX
+
+        probe = f"{PREFIX}/.conditional-write-probe-{uuid.uuid4().hex}"
         self._request("PUT", probe, payload=b"probe")
-        status, _ = self._request(
-            "PUT", probe, payload=b"probe2",
-            headers={"If-None-Match": "*"}, ok=(200, 409, 412, 501),
-        )
-        self._request("DELETE", probe, ok=(200, 204, 404))
+        try:
+            status, _ = self._request(
+                "PUT", probe, payload=b"probe2",
+                headers={"If-None-Match": "*"}, ok=(200, 409, 412, 501),
+            )
+        finally:
+            self._request("DELETE", probe, ok=(200, 204, 404))
         if status not in (409, 412):
             raise BackendError(self._NO_CONDITIONAL)
         self._conditional_verified = True

@@ -25,6 +25,7 @@ from tpu_kubernetes.providers.base import ProviderError
 from tpu_kubernetes.shell import ExecutorError, ValidationError, default_executor
 from tpu_kubernetes.state import StateError
 from tpu_kubernetes.topology import TopologyError
+from tpu_kubernetes.util import log
 from tpu_kubernetes.util.backend_prompt import prompt_for_backend
 from tpu_kubernetes.util.prompts import PromptError
 from tpu_kubernetes.util.trace import TRACER
@@ -55,6 +56,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--timing", action="store_true",
         help="print phase timing JSON to stderr on exit",
     )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="suppress progress output (warnings and errors still print)",
+    )
+    parser.add_argument(
+        "--verbose", action="store_true",
+        help="debug-level detail (rendered paths, control-plane calls)",
+    )
     sub = parser.add_subparsers(dest="command", required=True)
 
     create = sub.add_parser("create", help="create a manager, cluster, or node")
@@ -81,6 +90,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    log.set_verbosity(quiet=args.quiet, verbose=args.verbose)
 
     if args.command == "version":
         # reference: cmd/version.go:13-26
@@ -99,7 +109,7 @@ def main(argv: list[str] | None = None) -> int:
         backend = prompt_for_backend(cfg)
         executor = default_executor()
         if args.command == "create":
-            print(f"Creating {args.kind}...")  # reference: cmd/create.go:46,53,60
+            log.info(f"creating {args.kind}")  # reference: cmd/create.go:46,53,60
             if args.kind == "manager":
                 create_wf.new_manager(backend, cfg, executor)
             elif args.kind == "cluster":
@@ -107,7 +117,7 @@ def main(argv: list[str] | None = None) -> int:
             else:
                 create_wf.new_node(backend, cfg, executor)
         elif args.command == "destroy":
-            print(f"Destroying {args.kind}...")
+            log.info(f"destroying {args.kind}")
             if args.kind == "manager":
                 destroy_wf.delete_manager(backend, cfg, executor)
             elif args.kind == "cluster":
@@ -115,7 +125,7 @@ def main(argv: list[str] | None = None) -> int:
             else:
                 destroy_wf.delete_node(backend, cfg, executor)
         elif args.command == "repair":
-            print("Repairing cluster...")
+            log.info("repairing cluster")
             keys = repair_wf.repair_cluster(backend, cfg, executor)
             if keys:
                 print(f"Repaired {len(keys)} module(s).")
@@ -142,7 +152,7 @@ def main(argv: list[str] | None = None) -> int:
         KubeconfigError,
     ) as e:
         # reference prints the error then exits 1 (cmd/create.go:48-50)
-        print(f"error: {e}", file=sys.stderr)
+        log.error(str(e))
         return 1
     finally:
         if args.timing:

@@ -24,15 +24,11 @@ unverified TLS connection.
 from __future__ import annotations
 
 import re
-import sys
 
 from tpu_kubernetes.fleet.api import FleetAPI
+from tpu_kubernetes.util.log import warn as _warn
 
 _TOKEN_RE = re.compile(r"^([a-z0-9]{6})\.[a-z0-9]{16}$")
-
-
-def _warn(msg: str) -> None:
-    print(f"[tpu-k8s] WARNING: {msg}", file=sys.stderr)
 
 
 def deregister_cluster(api: FleetAPI, cluster_name: str) -> bool:

@@ -31,13 +31,12 @@ def _destroy_skipped(executor: Executor, what: str) -> bool:
 
 
 def _warn_no_fleet(what: str) -> None:
-    import sys
+    from tpu_kubernetes.util import log
 
-    print(
-        f"[tpu-k8s] WARNING: {what} was NOT cleaned up on the manager "
+    log.warn(
+        f"{what} was NOT cleaned up on the manager "
         "(no live api_url/secret_key outputs) — stale kube Node objects "
-        "and/or its join token may remain; see tpu_kubernetes/fleet/nodes.py",
-        file=sys.stderr,
+        "and/or its join token may remain; see tpu_kubernetes/fleet/nodes.py"
     )
 
 

@@ -64,6 +64,9 @@ class FleetAPI:
         """→ (status, parsed-JSON-or-None). 4xx/5xx come back as the status
         (no exception); transport errors raise — callers on best-effort
         paths catch broadly and warn."""
+        from tpu_kubernetes.util import log
+
+        log.debug(f"fleet API: {method} {path}")
         data = None
         req = urllib.request.Request(self.base + path, method=method)
         req.add_header("Authorization", f"Bearer {self.token}")

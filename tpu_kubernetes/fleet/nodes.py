@@ -32,18 +32,13 @@ A destroyed module maps to kube Node objects two ways:
 
 from __future__ import annotations
 
-import sys
 import urllib.parse
-from typing import Any
 
 from tpu_kubernetes.fleet.api import FleetAPI
+from tpu_kubernetes.util.log import warn as _warn
 
 _OK = (200, 202)
 _OK_OR_GONE = (200, 202, 404)
-
-
-def _warn(msg: str) -> None:
-    print(f"[tpu-k8s] WARNING: {msg}", file=sys.stderr)
 
 
 def list_nodes(api: FleetAPI, selector: str | None = None) -> list[dict]:

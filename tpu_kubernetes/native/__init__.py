@@ -63,11 +63,11 @@ def _build(source: Path, out: Path) -> bool:
     except (OSError, subprocess.TimeoutExpired):
         return False
     if proc.returncode != 0:
-        print(
-            f"[tpu-k8s] native build failed ({proc.stderr.strip().splitlines()[-1] if proc.stderr.strip() else 'unknown error'}); "
-            "using pure-Python runtime",
-            file=sys.stderr,
-        )
+        from tpu_kubernetes.util import log
+
+        tail = (proc.stderr.strip().splitlines()[-1]
+                if proc.stderr.strip() else "unknown error")
+        log.warn(f"native build failed ({tail}); using pure-Python runtime")
         return False
     tmp.replace(out)  # atomic: concurrent builders race benignly
     return True

@@ -57,8 +57,11 @@ class Executor(abc.ABC):
 def render_to_dir(state: State, directory: str | Path) -> Path:
     """Write the document to ``<dir>/main.tf.json``.
     reference: shell/run_terraform.go:13-24."""
+    from tpu_kubernetes.util import log
+
     path = Path(directory) / STATE_FILE
     path.write_bytes(state.to_bytes())
+    log.debug(f"rendered {state.name!r} to {path}")
     return path
 
 
@@ -83,7 +86,9 @@ class TerraformExecutor(Executor):
         subprocess."""
         cmd = [self.terraform_bin, *args]
         from tpu_kubernetes import native
+        from tpu_kubernetes.util import log
 
+        log.debug(f"exec: {' '.join(cmd)} (cwd {cwd})")
         if native.available():
             code, tail = native.run_streaming(
                 cmd, cwd=cwd, timeout_s=self.timeout_s,

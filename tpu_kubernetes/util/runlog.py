@@ -38,10 +38,9 @@ def record_run(
     try:
         backend.persist_run_report(manager, report)
     except Exception as e:  # noqa: BLE001 — observability must not fail a run
-        import sys
+        from tpu_kubernetes.util import log
 
-        print(f"[tpu-k8s] WARNING: could not persist run report: {e}",
-              file=sys.stderr)
+        log.warn(f"could not persist run report: {e}")
 
 
 @contextlib.contextmanager

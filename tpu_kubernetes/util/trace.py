@@ -35,15 +35,18 @@ class Tracer:
 
     @contextlib.contextmanager
     def phase(self, name: str, **meta):
+        from tpu_kubernetes.util import log
+
         span = Span(name=name, start=time.monotonic(), meta=dict(meta))
         self.spans.append(span)
-        if self.enabled:
+        show = self.enabled and log.level() >= log.NORMAL
+        if show:
             print(f"[tpu-k8s] ▶ {name}", file=self.stream)
         try:
             yield span
         finally:
             span.end = time.monotonic()
-            if self.enabled:
+            if show:
                 print(f"[tpu-k8s] ✓ {name} ({span.seconds:.1f}s)", file=self.stream)
 
     def mark(self) -> int:
