@@ -1,0 +1,171 @@
+"""CA TOFU-pinning for credential-bearing control-plane calls (ADVICE r03:
+the fleet-admin token must never ride fully-unverified TLS).
+
+The happy path spins a real TLS server on a self-signed cert (generated
+with the in-image ``cryptography`` package), serves /cacerts k3s-style,
+and proves a pinned client both connects and actually VERIFIES (a second
+server on a different cert is rejected)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import ssl
+import threading
+from datetime import datetime, timedelta, timezone
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from tpu_kubernetes.fleet import FleetAPI
+from tpu_kubernetes.util.bootstrap_tls import (
+    BootstrapTLSError,
+    pinned_urlopen_kwargs,
+    urlopen_kwargs,
+)
+
+
+def make_cert(tmp_path, name: str):
+    """Self-signed cert+key PEM files for 127.0.0.1 → (cert_path, key_path)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    subject = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "tpu-k8s-test")]
+    )
+    import ipaddress
+
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(subject)
+        .issuer_name(subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(datetime.now(timezone.utc) - timedelta(days=1))
+        .not_valid_after(datetime.now(timezone.utc) + timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName(
+                [x509.IPAddress(ipaddress.ip_address("127.0.0.1"))]
+            ),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    cert_path = tmp_path / f"{name}.crt"
+    key_path = tmp_path / f"{name}.key"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption(),
+    ))
+    return cert_path, key_path
+
+
+class CacertsHandler(BaseHTTPRequestHandler):
+    """k3s-style: /cacerts serves the CA PEM; /api/v1/nodes answers JSON."""
+
+    ca_pem: bytes = b""
+
+    def do_GET(self):  # noqa: N802
+        if self.path == "/cacerts":
+            body = self.ca_pem
+            self.send_response(200)
+        elif self.path == "/api/v1/nodes":
+            body = json.dumps({"items": []}).encode()
+            self.send_response(200)
+        else:
+            body = b"{}"
+            self.send_response(404)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def tls_server(tmp_path):
+    cert_path, key_path = make_cert(tmp_path, "ca")
+
+    handler = type("H", (CacertsHandler,), {"ca_pem": cert_path.read_bytes()})
+    server = HTTPServer(("127.0.0.1", 0), handler)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_path, key_path)
+    server.socket = ctx.wrap_socket(server.socket, server_side=True)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield (
+            f"https://127.0.0.1:{server.server_address[1]}",
+            cert_path.read_bytes(),
+        )
+    finally:
+        server.shutdown()
+        thread.join(timeout=5)
+
+
+def test_http_urls_need_no_context():
+    assert pinned_urlopen_kwargs("http://10.0.0.1:6443") == {}
+    assert urlopen_kwargs("http://10.0.0.1:6443") == {}
+
+
+def test_pin_accepts_matching_checksum(tls_server):
+    url, ca_pem = tls_server
+    checksum = hashlib.sha256(ca_pem).hexdigest()
+    kwargs = pinned_urlopen_kwargs(url, checksum)
+    ctx = kwargs["context"]
+    assert ctx.verify_mode == ssl.CERT_REQUIRED
+
+
+def test_pin_rejects_mismatched_checksum(tls_server):
+    url, _ = tls_server
+    with pytest.raises(BootstrapTLSError, match="checksum mismatch"):
+        pinned_urlopen_kwargs(url, "0" * 64)
+
+
+def test_pin_without_recorded_checksum_still_verifies(tls_server):
+    """No recorded ca_checksum → TOFU: the served CA becomes the session
+    trust root (still strictly better than CERT_NONE)."""
+    url, _ = tls_server
+    ctx = pinned_urlopen_kwargs(url, None)["context"]
+    assert ctx.verify_mode == ssl.CERT_REQUIRED
+
+
+def test_fleet_api_roundtrip_over_pinned_tls(tls_server):
+    url, ca_pem = tls_server
+    api = FleetAPI(url, "tok", ca_checksum=hashlib.sha256(ca_pem).hexdigest())
+    status, doc = api.get("/api/v1/nodes")
+    assert status == 200 and doc == {"items": []}
+
+
+def test_pinned_context_rejects_other_certs(tls_server, tmp_path):
+    """The pinned context must refuse a server whose cert the pinned CA
+    did not sign — the MITM case CERT_NONE allowed."""
+    url, _ = tls_server
+    ctx = pinned_urlopen_kwargs(url)["context"]
+
+    other_cert, other_key = make_cert(tmp_path, "other")
+    handler = type("H2", (CacertsHandler,), {"ca_pem": b"x"})
+    server = HTTPServer(("127.0.0.1", 0), handler)
+    sctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    sctx.load_cert_chain(other_cert, other_key)
+    server.socket = sctx.wrap_socket(server.socket, server_side=True)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        import urllib.error
+        import urllib.request
+
+        with pytest.raises(urllib.error.URLError):
+            urllib.request.urlopen(
+                f"https://127.0.0.1:{server.server_address[1]}/cacerts",
+                timeout=5, context=ctx,
+            )
+    finally:
+        server.shutdown()
+        thread.join(timeout=5)
