@@ -486,9 +486,9 @@ def _merge_dense(result: dict) -> None:
         "vs_baseline": round(result["mfu"] / 0.40, 4),
         "chips": 1,
         "isolation": "subprocess-per-section",
-        # r03 attribution (VERDICT Weak #2): dense 388.4→399.0 ms came from
-        # MoE+decode joining the dense process; sections are now isolated
-        "note": "sections run in isolated subprocesses",
+        "note": ("r03 dense regression (388.4->399.0ms) attributed to "
+                 "MoE+decode co-resident in the dense process; sections "
+                 "now run in isolated subprocesses"),
         **{k: v for k, v in result.items() if k != "mfu"},
     })
 

@@ -348,6 +348,39 @@ class TestRepairAuto:
         )
         assert "missing" in capsys.readouterr().out
 
+    def test_replace_confirm_warns_about_running_pods(self, kube, tmp_path):
+        """Replacing a node with live workloads says so in the confirmation
+        (VERDICT r03 Weak #5: one confirm covered dead and live alike)."""
+        server, url = kube
+        ex = _fleet_executor(url)
+        backend = _cluster(tmp_path, ex)
+        server.nodes["10-0-0-41"] = make_node("10-0-0-41", ready=False)
+        server.nodes["10-0-0-42"] = make_node("10-0-0-42")
+        server.pods = [{
+            "metadata": {"namespace": "default", "name": f"job-{i}"},
+            "spec": {"nodeName": "10-0-0-41"},
+            # two Running + one Succeeded: completed pods stay bound via
+            # spec.nodeName but must not inflate the advisory
+            "status": {"phase": "Succeeded" if i == 2 else "Running"},
+        } for i in range(3)]
+
+        from tpu_kubernetes.repair import repair_cluster
+
+        asked = []
+
+        class RecordingConfig(Config):
+            def confirm(self, question):
+                asked.append(question)
+                return True
+
+        # interactive (non_interactive=False): the advisory only computes
+        # when a prompt would actually be shown
+        cfg = RecordingConfig(values={
+            "cluster_manager": "dev", "cluster_name": "alpha", "auto": True,
+        }, non_interactive=False, env={})
+        repair_cluster(backend, cfg, ex)
+        assert any("2 pod(s) are currently Running" in q for q in asked)
+
     def test_manager_unreachable_fails_loudly(self, tmp_path):
         ex = _fleet_executor("http://127.0.0.1:9")
         backend = _cluster(tmp_path, ex)

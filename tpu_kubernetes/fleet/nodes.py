@@ -85,16 +85,40 @@ def cordon(api: FleetAPI, name: str) -> bool:
     return status in _OK
 
 
+def _pods_bound_to(api: FleetAPI, name: str) -> list[dict]:
+    """Pod items with spec.nodeName == ``name``. Raises on API trouble —
+    callers decide whether that is fatal (drain: no) or advisory (count)."""
+    selector = urllib.parse.quote(f"spec.nodeName={name}")
+    status, doc = api.get(f"/api/v1/pods?fieldSelector={selector}")
+    if status != 200 or not isinstance(doc, dict):
+        raise RuntimeError(f"list pods on {name} (HTTP {status})")
+    return list(doc.get("items") or [])
+
+
+def count_running_pods_on(api: FleetAPI, name: str) -> int | None:
+    """How many RUNNING pods are bound to Node ``name``; None when the
+    answer could not be obtained (callers must not read None as zero —
+    'could not check' and 'verified idle' are different messages)."""
+    try:
+        pods = _pods_bound_to(api, name)
+    except Exception:  # noqa: BLE001 — advisory only
+        return None
+    return sum(
+        1 for p in pods
+        if ((p.get("status") or {}).get("phase", "Running")) == "Running"
+    )
+
+
 def delete_pods_on(api: FleetAPI, name: str) -> int:
     """Eviction-free drain: delete every pod bound to ``name`` with grace 0
     (the kubelet is dead — graceful termination has no executor). Returns
     how many deletes were issued; failures are counted, not raised."""
-    selector = urllib.parse.quote(f"spec.nodeName={name}")
-    status, doc = api.get(f"/api/v1/pods?fieldSelector={selector}")
-    if status != 200 or not isinstance(doc, dict):
+    try:
+        pods = _pods_bound_to(api, name)
+    except Exception:  # noqa: BLE001 — drain is best-effort
         return 0
     issued = 0
-    for pod in doc.get("items") or []:
+    for pod in pods:
         meta = pod.get("metadata") or {}
         ns, pod_name = meta.get("namespace"), meta.get("name")
         if not (ns and pod_name):
