@@ -401,6 +401,39 @@ class TestRepairAuto:
             }), ex)
 
 
+class TestGetManagerFleetSummary:
+    def test_fleet_nodes_grouped_by_cluster(self, kube, tmp_path):
+        server, url = kube
+        ex = _fleet_executor(url)
+        backend = _cluster(tmp_path, ex)
+        server.nodes["mgr"] = make_node(
+            "mgr", labels={"tpu-kubernetes/role": "manager"}
+        )
+        server.nodes["a-1"] = make_node(
+            "a-1", labels={"tpu-kubernetes/cluster": "alpha"}
+        )
+        server.nodes["a-2"] = make_node(
+            "a-2", ready=False, labels={"tpu-kubernetes/cluster": "alpha"}
+        )
+
+        from tpu_kubernetes.get.workflows import get_manager
+
+        out = get_manager(backend, _cfg({"cluster_manager": "dev"}), ex)
+        assert out["fleet_nodes"] == {
+            "manager": {"ready": 1, "not_ready": 0},
+            "alpha": {"ready": 1, "not_ready": 1},
+        }
+
+    def test_unreachable_manager_reports_error_in_band(self, tmp_path):
+        ex = _fleet_executor("http://127.0.0.1:9")
+        backend = _cluster(tmp_path, ex)
+
+        from tpu_kubernetes.get.workflows import get_manager
+
+        out = get_manager(backend, _cfg({"cluster_manager": "dev"}), ex)
+        assert "fleet_health_error" in out
+
+
 class TestGetClusterHealth:
     def test_node_health_table(self, kube, tmp_path):
         server, url = kube

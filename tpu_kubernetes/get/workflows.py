@@ -18,13 +18,49 @@ from tpu_kubernetes.state import MANAGER_KEY
 
 def get_manager(backend: Backend, cfg: Config, executor: Executor) -> dict[str, Any]:
     """reference: get/manager.go:83-92 — plus the latest run report (phase
-    timing breakdown, SURVEY §5.1) which the reference has no analog for."""
+    timing breakdown, SURVEY §5.1) and a fleet-wide node summary (Ready
+    counts per cluster, one Nodes list) which the reference delegates to
+    the Rancher UI."""
     manager = select_manager(backend, cfg)
     state = backend.state(manager)
     out = executor.output(state, MANAGER_KEY)
     last_run = backend.last_run_report(manager)
     if last_run is not None:
         out = {**out, "last_run": last_run}
+
+    api_url, token = out.get("api_url"), out.get("secret_key")
+    if api_url and token:
+        from tpu_kubernetes.fleet import FleetAPI, list_nodes, node_ready
+
+        # pin the manager CA with any registered cluster's recorded
+        # ca_checksum (shared control plane: they all pin the same CA);
+        # short timeouts — this is advisory, terraform outputs are the
+        # answer the user actually asked for
+        ca = None
+        cluster_key = next(iter(state.clusters().values()), None)
+        if cluster_key:
+            try:
+                ca = executor.output(state, cluster_key).get("ca_checksum")
+            except Exception:  # noqa: BLE001 — pin is best-available
+                pass
+        try:
+            items = list_nodes(FleetAPI(
+                str(api_url), str(token),
+                ca_checksum=str(ca) if ca else None, timeout_s=5.0,
+            ))
+        except Exception as e:  # noqa: BLE001 — health is best-effort here
+            out = {**out, "fleet_health_error": str(e)[:200]}
+        else:
+            summary: dict[str, dict[str, int]] = {}
+            for item in items:
+                labels = (item.get("metadata") or {}).get("labels") or {}
+                pool = (
+                    "manager" if labels.get("tpu-kubernetes/role") == "manager"
+                    else labels.get("tpu-kubernetes/cluster") or "(unlabeled)"
+                )
+                bucket = summary.setdefault(pool, {"ready": 0, "not_ready": 0})
+                bucket["ready" if node_ready(item) else "not_ready"] += 1
+            out = {**out, "fleet_nodes": summary}
     return out
 
 
