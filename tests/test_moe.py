@@ -172,6 +172,48 @@ class TestDispatchModes:
         with pytest.raises(ValueError, match="dispatch_mode"):
             forward(params32, tokens, replace(self.CFG32, dispatch_mode="sorted"))
 
+    @pytest.mark.parametrize("seed,n_experts,k,capacity_factor,b,s", [
+        (0, 4, 1, 1.0, 1, 16),    # top-1 (Switch-style)
+        (1, 4, 3, 1.25, 2, 24),   # k=3 — more rounds than the default
+        (2, 3, 2, 0.75, 2, 32),   # non-power-of-two experts, tight capacity
+        (3, 8, 2, 0.25, 1, 64),   # heavy overflow dropping
+        (4, 2, 2, 2.0, 3, 8),     # k == E: every expert selected
+    ])
+    def test_parity_sweep(self, seed, n_experts, k, capacity_factor, b, s):
+        """Randomized routing-shape sweep: the gather path must match the
+        einsum oracle (outputs AND sublayer gradients) for every corner of
+        the routing space — k=1, k=E, odd expert counts, capacities that
+        drop most tokens."""
+        cfg = replace(
+            self.CFG32, n_experts=n_experts, experts_per_token=k,
+            capacity_factor=capacity_factor,
+        )
+        d, ff = cfg.d_model, cfg.d_ff
+        keys = jax.random.split(jax.random.PRNGKey(100 + seed), 6)
+        layer = {
+            "mlp_norm": jnp.ones((d,), jnp.float32),
+            "w_router": jax.random.normal(keys[0], (d, n_experts), jnp.float32)
+            / d ** 0.5,
+            "w_gate": jax.random.normal(keys[1], (n_experts, d, ff), jnp.float32) * 0.05,
+            "w_up": jax.random.normal(keys[2], (n_experts, d, ff), jnp.float32) * 0.05,
+            "w_down": jax.random.normal(keys[3], (n_experts, ff, d), jnp.float32) * 0.05,
+        }
+        x = jax.random.normal(keys[4], (b, s, d), jnp.float32)
+
+        def run(mode, x, layer):
+            out, aux = moe_sublayer(replace(cfg, dispatch_mode=mode), x, layer)
+            return jnp.sum(out * jnp.cos(out)) + aux  # mixes every element
+
+        val_g, grads_g = jax.value_and_grad(run, argnums=(1, 2))("gather", x, layer)
+        val_e, grads_e = jax.value_and_grad(run, argnums=(1, 2))("einsum", x, layer)
+        np.testing.assert_allclose(
+            np.asarray(val_g), np.asarray(val_e), rtol=2e-4, atol=2e-4
+        )
+        for a, b_ in zip(jax.tree.leaves(grads_g), jax.tree.leaves(grads_e)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=2e-3, atol=2e-4
+            )
+
 
 def test_single_expert_matches_dense_mlp(params):
     """n_experts=1, k=1, ample capacity routes every token through the one
