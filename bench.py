@@ -89,7 +89,9 @@ def hbm_bytes_per_sec(device) -> float | None:
     return gbps * 1e9 if gbps else None
 
 
-_emit_lock = None  # threading.Lock, created in __main__
+import threading
+
+_emit_lock = threading.Lock()
 _emitted = False
 
 
@@ -98,10 +100,7 @@ def emit(obj: dict) -> None:
     main and the watchdog race (ADVICE r03: main printing while the
     watchdog fires could produce two lines)."""
     global _emitted
-    import threading
-
-    lock = _emit_lock or threading.Lock()
-    with lock:
+    with _emit_lock:
         if _emitted:
             return
         _emitted = True
@@ -515,18 +514,20 @@ def main() -> None:
             _result_printed.set()
         return
 
+    def fail_round(msg: str) -> None:
+        # no dense number is ever coming → the round's error form (a
+        # metric-less JSON line would break the driver contract)
+        emit_error(msg)
+        if _result_printed is not None:
+            _result_printed.set()
+
     deadline = float(os.environ.get("BENCH_DEADLINE_S", "1500"))
     t_start = time.perf_counter()
     for section in _sections_wanted():
         budget = deadline - (time.perf_counter() - t_start) - 30.0
         if budget < 60.0:
             if section == "dense":
-                # no dense number is ever coming → the round's error form
-                # (a metric-less JSON line would break the driver contract)
-                emit_error("dense section skipped: deadline budget exhausted")
-                if _result_printed is not None:
-                    _result_printed.set()
-                return
+                return fail_round("dense section skipped: deadline budget exhausted")
             _PARTIAL.setdefault(section, {"error": "skipped: deadline budget exhausted"})
             log(f"{section}: skipped, {budget:.0f}s budget left")
             continue
@@ -551,11 +552,7 @@ def main() -> None:
 
         if section == "dense":
             if "error" in result:
-                # no dense number → the round's error form
-                emit_error(f"dense section failed: {result['error']}")
-                if _result_printed is not None:
-                    _result_printed.set()
-                return
+                return fail_round(f"dense section failed: {result['error']}")
             _merge_dense(result)
         else:
             _PARTIAL[section] = result
@@ -566,9 +563,6 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    import threading
-
-    _emit_lock = threading.Lock()
     if "--section" in sys.argv:
         # child mode: no watchdog (the parent's subprocess timeout bounds
         # us), no one-line contract (the parent owns the driver-facing line)

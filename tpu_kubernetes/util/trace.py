@@ -14,6 +14,8 @@ import sys
 import time
 from dataclasses import dataclass, field
 
+from tpu_kubernetes.util import log
+
 
 @dataclass
 class Span:
@@ -35,8 +37,6 @@ class Tracer:
 
     @contextlib.contextmanager
     def phase(self, name: str, **meta):
-        from tpu_kubernetes.util import log
-
         span = Span(name=name, start=time.monotonic(), meta=dict(meta))
         self.spans.append(span)
         show = self.enabled and log.level() >= log.NORMAL
