@@ -196,6 +196,39 @@ def probe_backend(max_tries: int = 3, probe_timeout_s: float = 150.0) -> None:
     raise RuntimeError(f"accelerator backend unavailable: {last}")
 
 
+def _sync(x) -> None:
+    """Synchronize by TRANSFER, not just block_until_ready: on the tunneled
+    remote backend, block_until_ready on a queued computation's output can
+    return before the device work finishes (observed: 128-token decode
+    'measured' at 0.1 ms); fetching a scalar from the output forces the
+    whole queue to drain. On a local chip the extra device_get costs ~0."""
+    import jax
+
+    jax.block_until_ready(x)
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    jax.device_get(leaf.ravel()[0])
+
+
+def measure_rtt() -> float:
+    """What one _sync call on an already-ready array costs — the constant
+    _sync adds to every timed region (subtract it once per region). ~0.1 ms
+    locally, tens of ms over the tunnel. Each sample builds a FRESH device
+    array and times the full _sync path: jax.Array caches its host value
+    after the first transfer, so re-fetching one array would measure cache
+    hits (~0) and silently zero the correction."""
+    import jax
+    import jax.numpy as jnp
+
+    samples = []
+    for i in range(3):
+        a = jnp.full((), i, jnp.int32) + 1
+        jax.block_until_ready(a)
+        t0 = time.perf_counter()
+        _sync(a)
+        samples.append(time.perf_counter() - t0)
+    return sorted(samples)[1]
+
+
 def model_flops_per_token(cfg, n_params: int, seq: int) -> float:
     """Standard training-FLOPs estimate: 6N for the dense path plus
     12·L·d_model·seq for attention scores/values (causal halves it).
@@ -267,15 +300,16 @@ def measure_train(model_name: str, batch: int, seq: int, steps: int,
         t0 = time.perf_counter()
         for _ in range(warmup):
             state, loss = step(state, next(batches))
-        jax.block_until_ready(loss)
+        _sync(loss)
         log(f"{model_name}: warmup+compile={time.perf_counter()-t0:.1f}s "
             f"loss={float(loss):.3f}")
 
+        rtt = measure_rtt()
         t0 = time.perf_counter()
         for _ in range(steps):
             state, loss = step(state, next(batches))
-        jax.block_until_ready(loss)
-        elapsed = time.perf_counter() - t0
+        _sync(loss)
+        elapsed = max(1e-9, time.perf_counter() - t0 - rtt)
 
     step_time = elapsed / steps
     tokens_per_sec = batch * seq / step_time
@@ -335,26 +369,27 @@ def measure_decode(model_name: str, batch: int, prompt_len: int,
         ))
         t0 = time.perf_counter()
         out = gen(params, prompt)
-        jax.block_until_ready(out)
+        _sync(out)
         log(f"decode: compile+first={time.perf_counter()-t0:.1f}s")
 
+        rtt = measure_rtt()
         t0 = time.perf_counter()
         for _ in range(reps):
             out = gen(params, prompt)
-        jax.block_until_ready(out)
-        per_call = (time.perf_counter() - t0) / reps
+        _sync(out)
+        per_call = max(1e-9, time.perf_counter() - t0 - rtt) / reps
 
         # time prefill alone so the decode-step figures don't amortize the
         # prompt pass into "tokens/s" (same cache shape as inside generate)
         pf = jax.jit(lambda p, t: prefill(
             p, t, cfg, max_seq=prompt_len + max_new
         )[0])
-        jax.block_until_ready(pf(params, prompt))  # compile
+        _sync(pf(params, prompt))  # compile
         t0 = time.perf_counter()
         for _ in range(reps):
             logits = pf(params, prompt)
-        jax.block_until_ready(logits)
-        prefill_time = (time.perf_counter() - t0) / reps
+        _sync(logits)
+        prefill_time = max(1e-9, time.perf_counter() - t0 - rtt) / reps
 
     decode_time = per_call - prefill_time
     if decode_time <= 0.1 * per_call:

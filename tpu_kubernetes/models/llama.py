@@ -185,11 +185,38 @@ def _block(cfg: ModelConfig, cos, sin, x, layer):
     return x + gated @ layer["w_down"]
 
 
+# checkpoint_name tags the MoE layer places on its routing plan and
+# bucketed activations (models/moe.py) — saved under the "moe" policy so
+# the backward pass never re-runs the routing machinery (argmax rounds,
+# cumsums, the slot scatter, the dispatch gathers)
+MOE_SAVED_NAMES = (
+    "moe_plan",
+    "moe_dispatch",
+    "moe_expert_out",
+)
+
+
 def remat_policy_kwargs(cfg: ModelConfig):
     """→ kwargs for jax.checkpoint per cfg.remat_policy."""
     if cfg.remat_policy == "dots":
         return {
             "policy": jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        }
+    if cfg.remat_policy == "moe":
+        # "dots" + the MoE layer's named intermediates. Without the names,
+        # dots_with_no_batch_dims_saveable saves NONE of the MoE machinery
+        # (expert einsums are batched over the expert dim; routing is not a
+        # dot at all), so the whole routing chain and dispatch gathers run
+        # twice per step. The named tensors are the d-sized bucketed
+        # activations (~42 MB/layer at bench shapes) and the int/f32 plan
+        # (~KBs) — the ff-sized expert intermediates stay unsaved.
+        return {
+            "policy": jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names(
+                    *MOE_SAVED_NAMES
+                ),
+            )
         }
     if cfg.remat_policy == "full":
         return {}

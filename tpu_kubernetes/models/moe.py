@@ -66,6 +66,10 @@ class MoEConfig(ModelConfig):
     # "gather": indexed dispatch/combine (row gathers, custom-VJP backward)
     # "einsum": GShard dense one-hot dispatch (oracle; O(b·s·E·C·d) flops)
     dispatch_mode: str = "gather"
+    # MoE-aware remat: save the routing plan + bucketed activations so the
+    # backward never re-runs the routing machinery (llama.py:
+    # remat_policy_kwargs "moe" — "dots" alone saves none of it)
+    remat_policy: str = "moe"
 
 
 MOE_CONFIGS: dict[str, MoEConfig] = {
@@ -392,10 +396,27 @@ def moe_sublayer(cfg: MoEConfig, x, layer):
         out_e = jnp.einsum("ebcf,efd->ebcd", gated, layer["w_down"])
         out = jnp.einsum("ebcd,bsec->bsd", out_e, combine.astype(cfg.dtype))
     elif cfg.dispatch_mode == "gather":
+        from jax.ad_checkpoint import checkpoint_name
+
         dst, keep, weight, first = _route_plan(gates, cfg.experts_per_token, C)
         src, valid = _slot_sources(dst, keep, cfg.n_experts * C)
-        xe = _dispatch_rows(y, src, valid, dst, keep)
-        out_e = _expert_mlp(cfg, xe.reshape(b, cfg.n_experts, C, d), layer)
+        # name the plan + bucketed activations so the "moe" remat policy
+        # (llama.py:remat_policy_kwargs) saves them: the custom-VJP
+        # backwards below consume exactly these residuals, so the whole
+        # routing chain (argmax rounds, cumsums, the slot scatter) and the
+        # dispatch gather never re-run during the backward pass
+        dst = checkpoint_name(dst, "moe_plan")
+        keep = checkpoint_name(keep, "moe_plan")
+        weight = checkpoint_name(weight, "moe_plan")
+        src = checkpoint_name(src, "moe_plan")
+        valid = checkpoint_name(valid, "moe_plan")
+        xe = checkpoint_name(
+            _dispatch_rows(y, src, valid, dst, keep), "moe_dispatch"
+        )
+        out_e = checkpoint_name(
+            _expert_mlp(cfg, xe.reshape(b, cfg.n_experts, C, d), layer),
+            "moe_expert_out",
+        )
         out = _combine_rows(
             out_e.reshape(b, cfg.n_experts * C, d), weight, dst, keep, src, valid
         )
