@@ -28,6 +28,7 @@ measured step is to that floor (round-3 VERDICT Weak #3).
 
 Env knobs: BENCH_MODEL (default llama-1b), BENCH_BATCH, BENCH_SEQ,
 BENCH_STEPS, BENCH_WARMUP, BENCH_MOE_MODEL (default moe-1b; empty skips),
+BENCH_MOE_BATCH (default BENCH_BATCH),
 BENCH_DECODE_BATCH/PROMPT/NEW (empty BENCH_DECODE_NEW skips decode).
 """
 
@@ -480,7 +481,12 @@ def _measure_section(section: str, device, peak, bw) -> dict:
     if section == "moe":
         return measure_train(
             os.environ.get("BENCH_MOE_MODEL", "moe-1b"),
-            batch, seq, steps, warmup, device, peak,
+            # per-chip-normalized MFU is batch-size-fair, and the MoE's
+            # per-expert matmuls (M = batch·capacity) want more rows than
+            # the dense model needs — so the MoE section takes its own
+            # batch knob (default: the shared BENCH_BATCH)
+            int(os.environ.get("BENCH_MOE_BATCH", str(batch))),
+            seq, steps, warmup, device, peak,
         )
     if section == "decode":
         return measure_decode(

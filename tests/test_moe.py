@@ -215,6 +215,43 @@ class TestDispatchModes:
             )
 
 
+class TestMoERematPolicy:
+    """The "moe" remat policy (MoEConfig default) saves the named routing
+    plan + bucketed activations (llama.py:MOE_SAVED_NAMES) so the backward
+    pass reuses them instead of re-running the routing machinery. It must
+    be numerically indistinguishable from no-remat and plain-"dots" remat."""
+
+    def test_loss_and_grad_parity_across_remat_modes(self):
+        cfg = replace(CFG, remat=True)  # remat_policy="moe" is the default
+        assert cfg.remat_policy == "moe"
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(21), (2, 64), 0, cfg.vocab_size
+        )
+
+        def lossgrad(c):
+            return jax.jit(
+                jax.value_and_grad(lambda p: loss_fn(p, tokens, c))
+            )(params)
+
+        losses, grads = zip(*[
+            lossgrad(c) for c in (
+                cfg,
+                replace(cfg, remat=False),
+                replace(cfg, remat_policy="dots"),
+            )
+        ])
+        np.testing.assert_allclose(
+            [float(v) for v in losses[1:]], float(losses[0]), rtol=1e-5
+        )
+        for other in grads[1:]:
+            for a, b in zip(jax.tree.leaves(grads[0]), jax.tree.leaves(other)):
+                np.testing.assert_allclose(
+                    np.asarray(a, np.float32), np.asarray(b, np.float32),
+                    atol=1e-3, rtol=2e-2,  # bf16 params → bf16 grad rounding
+                )
+
+
 def test_single_expert_matches_dense_mlp(params):
     """n_experts=1, k=1, ample capacity routes every token through the one
     expert with weight 1.0 — identical to a dense SwiGLU sublayer."""
