@@ -29,7 +29,8 @@ measured step is to that floor (round-3 VERDICT Weak #3).
 Env knobs: BENCH_MODEL (default llama-1b), BENCH_BATCH, BENCH_SEQ,
 BENCH_STEPS, BENCH_WARMUP, BENCH_MOE_MODEL (default moe-1b; empty skips),
 BENCH_MOE_BATCH (default BENCH_BATCH),
-BENCH_DECODE_BATCH/PROMPT/NEW (empty BENCH_DECODE_NEW skips decode).
+BENCH_DECODE_BATCH/PROMPT/NEW (empty BENCH_DECODE_NEW skips decode),
+BENCH_PROBE_TRIES (default 4 — each try is a ≤150 s subprocess probe).
 """
 
 from __future__ import annotations
@@ -160,7 +161,8 @@ def start_watchdog(deadline_s: float) -> None:
     threading.Thread(target=fire, daemon=True).start()
 
 
-def probe_backend(max_tries: int = 3, probe_timeout_s: float = 150.0) -> None:
+def probe_backend(max_tries: int | None = None,
+                  probe_timeout_s: float = 150.0) -> None:
     """Wait until the accelerator backend can actually initialize.
 
     Probes in a SUBPROCESS with a hard timeout: the shared tunneled chip is
@@ -170,6 +172,11 @@ def probe_backend(max_tries: int = 3, probe_timeout_s: float = 150.0) -> None:
     """
     import subprocess
 
+    if max_tries is None:
+        # the tunneled chip has been observed unavailable for minutes at a
+        # stretch; with a 1500 s section deadline there is room to out-wait
+        # short outages rather than forfeit the round
+        max_tries = int(os.environ.get("BENCH_PROBE_TRIES", "4"))
     delay = 10.0
     last = "unknown"
     for attempt in range(1, max_tries + 1):
@@ -533,9 +540,31 @@ def _merge_dense(result: dict) -> None:
     })
 
 
+def run_section_child(section: str, budget: float) -> dict:
+    """Run one section as a subprocess → its result dict (errors in-band)."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--section", section],
+            capture_output=True, text=True, timeout=budget,
+        )
+        sys.stderr.write(r.stderr)
+        if r.returncode != 0:
+            tail = (r.stderr.strip().splitlines() or ["?"])[-1][:300]
+            raise RuntimeError(f"rc={r.returncode}: {tail}")
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except subprocess.TimeoutExpired:
+        log(f"{section}: killed after {budget:.0f}s")
+        return {"error": f"section exceeded {budget:.0f}s budget"}
+    except Exception as e:  # noqa: BLE001 — extras stay in-band
+        log(f"{section} section failed: {e}")
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+
+
 def main() -> None:
     """Parent: orchestrate sections as subprocesses (never imports jax)."""
-    import subprocess
 
     if os.environ.get("BENCH_ISOLATION", "1") in ("0", "false", "no"):
         # single-process fallback: sections share one backend (debugging)
@@ -573,25 +602,17 @@ def main() -> None:
             log(f"{section}: skipped, {budget:.0f}s budget left")
             continue
         log(f"section {section}: starting (budget {budget:.0f}s)")
-        try:
-            r = subprocess.run(
-                [sys.executable, os.path.abspath(__file__),
-                 "--section", section],
-                capture_output=True, text=True, timeout=budget,
-            )
-            sys.stderr.write(r.stderr)
-            if r.returncode != 0:
-                tail = (r.stderr.strip().splitlines() or ["?"])[-1][:300]
-                raise RuntimeError(f"rc={r.returncode}: {tail}")
-            result = json.loads(r.stdout.strip().splitlines()[-1])
-        except subprocess.TimeoutExpired:
-            log(f"{section}: killed after {budget:.0f}s")
-            result = {"error": f"section exceeded {budget:.0f}s budget"}
-        except Exception as e:  # noqa: BLE001 — extras stay in-band
-            log(f"{section} section failed: {e}")
-            result = {"error": f"{type(e).__name__}: {e}"[:300]}
+        result = run_section_child(section, budget)
 
         if section == "dense":
+            if "error" in result:
+                # the round lives or dies on dense — one retry if the
+                # budget allows (a transiently-unavailable tunneled backend
+                # is the common failure, and it often recovers in minutes)
+                retry_budget = deadline - (time.perf_counter() - t_start) - 30.0
+                if retry_budget > 240.0:
+                    log(f"dense: retrying once (budget {retry_budget:.0f}s)")
+                    result = run_section_child(section, retry_budget)
             if "error" in result:
                 return fail_round(f"dense section failed: {result['error']}")
             _merge_dense(result)
