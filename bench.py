@@ -30,6 +30,7 @@ Env knobs: BENCH_MODEL (default llama-1b), BENCH_BATCH, BENCH_SEQ,
 BENCH_STEPS, BENCH_WARMUP, BENCH_MOE_MODEL (default moe-1b; empty skips),
 BENCH_MOE_BATCH (default BENCH_BATCH),
 BENCH_DECODE_BATCH/PROMPT/NEW (empty BENCH_DECODE_NEW skips decode),
+BENCH_DECODE_INT8 (default on; empty skips the int8-export timing),
 BENCH_PROBE_TRIES (default 4 — each try is a ≤150 s subprocess probe).
 """
 
@@ -338,16 +339,16 @@ def measure_train(model_name: str, batch: int, seq: int, steps: int,
     }
 
 
-def decode_roofline_seconds(cfg, n_params: int, batch: int,
+def decode_roofline_seconds(cfg, param_bytes: int, batch: int,
                             cache_len_avg: float, bw: float | None) -> float | None:
     """HBM floor for one decode step: stream all weights once + read the
     live K/V cache (GQA: kv heads only) + write one position. Activations
     and the f32 logits are ignored (small next to weights at these
-    shapes), so this is a strict lower bound."""
+    shapes), so this is a strict lower bound. ``param_bytes`` is the real
+    stored size (bf16, or int8+scales for a quantized export)."""
     if not bw:
         return None
-    dtype_bytes = 2  # bf16
-    param_bytes = n_params * dtype_bytes
+    dtype_bytes = 2  # bf16 cache
     kv_row = cfg.n_kv_heads * cfg.head_dim * dtype_bytes
     cache_read = 2 * cfg.n_layers * batch * kv_row * cache_len_avg  # k and v
     cache_write = 2 * cfg.n_layers * batch * kv_row
@@ -358,27 +359,30 @@ def measure_decode(model_name: str, batch: int, prompt_len: int,
                    max_new: int, device, bw: float | None = None) -> dict:
     """KV-cache serving throughput: generated tokens/sec (greedy) for the
     jitted prefill + lax.scan decode loop (models/decode.py), plus the
-    fraction of the HBM roofline the per-token step achieves."""
+    fraction of the HBM roofline the per-token step achieves. Also times
+    the int8 weight-only export (models/quant.py) against ITS roofline
+    (half the weight bytes) unless BENCH_DECODE_INT8 is empty."""
     import jax
 
-    from tpu_kubernetes.models import CONFIGS, init_params, param_count
+    from tpu_kubernetes.models import CONFIGS, init_params
     from tpu_kubernetes.models.decode import generate, prefill
+    from tpu_kubernetes.models.quant import (
+        quantize_for_decode,
+        quantized_param_bytes,
+    )
 
     cfg = CONFIGS[model_name]
     reps = 3
-    with jax.default_device(device):
-        params = init_params(jax.random.PRNGKey(0), cfg)
-        n_params = param_count(params)
-        prompt = jax.random.randint(
-            jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size
-        )
+
+    def time_variant(params, label: str) -> tuple[float, float]:
+        """→ (per_call_s, prefill_s) for one param pytree."""
         gen = jax.jit(lambda p, t: generate(
             p, t, cfg, max_new_tokens=max_new, temperature=0.0
         ))
         t0 = time.perf_counter()
         out = gen(params, prompt)
         _sync(out)
-        log(f"decode: compile+first={time.perf_counter()-t0:.1f}s")
+        log(f"{label}: compile+first={time.perf_counter()-t0:.1f}s")
 
         rtt = measure_rtt()
         t0 = time.perf_counter()
@@ -398,42 +402,83 @@ def measure_decode(model_name: str, batch: int, prompt_len: int,
             logits = pf(params, prompt)
         _sync(logits)
         prefill_time = max(1e-9, time.perf_counter() - t0 - rtt) / reps
+        return per_call, prefill_time
 
-    decode_time = per_call - prefill_time
-    if decode_time <= 0.1 * per_call:
-        # prefill dominates (tiny max_new or timing noise): a subtracted
-        # figure would be fabricated — degrade to the section's in-band
-        # error rather than report garbage tokens/s
-        raise RuntimeError(
-            f"decode time not measurable: per_call={per_call*1e3:.1f}ms "
-            f"prefill={prefill_time*1e3:.1f}ms — raise BENCH_DECODE_NEW"
+    def variant_result(per_call: float, prefill_time: float,
+                       param_bytes: int) -> dict:
+        decode_time = per_call - prefill_time
+        if decode_time <= 0.1 * per_call:
+            # prefill dominates (tiny max_new or timing noise): a
+            # subtracted figure would be fabricated — degrade in-band
+            # rather than report garbage tokens/s
+            raise RuntimeError(
+                f"decode time not measurable: per_call={per_call*1e3:.1f}ms "
+                f"prefill={prefill_time*1e3:.1f}ms — raise BENCH_DECODE_NEW"
+            )
+        tokens_per_sec = batch * max_new / decode_time
+        per_token_ms = decode_time / max_new * 1e3
+        # cache length averaged over the decode steps (prompt → prompt+new)
+        roofline_s = decode_roofline_seconds(
+            cfg, param_bytes, batch, prompt_len + max_new / 2, bw
         )
-    tokens_per_sec = batch * max_new / decode_time
-    per_token_ms = decode_time / max_new * 1e3
-    # cache length averaged over the decode steps (prompt → prompt+new)
-    roofline_s = decode_roofline_seconds(
-        cfg, n_params, batch, prompt_len + max_new / 2, bw
-    )
-    frac = (roofline_s * 1e3 / per_token_ms) if roofline_s else None
-    log(f"decode: tokens/s={tokens_per_sec:.0f} step={per_token_ms:.2f}ms "
-        f"(batch={batch}, prefill={prefill_time*1e3:.1f}ms, "
-        f"e2e={per_call*1e3:.1f}ms, "
-        f"hbm_roofline={roofline_s*1e3:.2f}ms frac={frac:.2f}"
-        if roofline_s else
-        f"decode: tokens/s={tokens_per_sec:.0f} step={per_token_ms:.2f}ms")
+        out = {
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "per_token_ms": round(per_token_ms, 3),
+            "prefill_ms": round(prefill_time * 1e3, 2),
+            "e2e_ms_per_call": round(per_call * 1e3, 2),
+        }
+        if roofline_s:
+            out["hbm_roofline_ms"] = round(roofline_s * 1e3, 3)
+            out["fraction_of_hbm_roofline"] = round(
+                roofline_s * 1e3 / per_token_ms, 3
+            )
+        return out
+
+    def log_variant(label: str, r: dict) -> None:
+        extra = ""
+        if "hbm_roofline_ms" in r:
+            extra = (f", hbm_roofline={r['hbm_roofline_ms']}ms "
+                     f"frac={r['fraction_of_hbm_roofline']}")
+        log(f"{label}: tokens/s={r['tokens_per_sec']:.0f} "
+            f"step={r['per_token_ms']:.2f}ms (batch={batch}, "
+            f"prefill={r['prefill_ms']:.1f}ms{extra})")
+
+    with jax.default_device(device):
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size
+        )
+        per_call, prefill_time = time_variant(params, "decode")
+        # validate the bf16 timing BEFORE spending minutes on the int8
+        # pass — a degenerate measurement fails the section either way
+        bf16_result = variant_result(
+            per_call, prefill_time,
+            quantized_param_bytes(params),  # = exact stored bytes (bf16)
+        )
+
+        int8_result = None
+        if os.environ.get("BENCH_DECODE_INT8", "1"):
+            try:
+                qparams = quantize_for_decode(params, cfg)
+                q_call, q_prefill = time_variant(qparams, "decode-int8")
+                int8_result = variant_result(
+                    q_call, q_prefill, quantized_param_bytes(qparams)
+                )
+                log_variant("decode-int8", int8_result)
+            except Exception as e:  # noqa: BLE001 — extra stays in-band
+                log(f"decode-int8 failed: {e}")
+                int8_result = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     out = {
         "model": model_name,
-        "tokens_per_sec": round(tokens_per_sec, 1),
-        "per_token_ms": round(per_token_ms, 3),
-        "prefill_ms": round(prefill_time * 1e3, 2),
-        "e2e_ms_per_call": round(per_call * 1e3, 2),
+        **bf16_result,
         "batch": batch,
         "prompt_len": prompt_len,
         "max_new_tokens": max_new,
     }
-    if roofline_s:
-        out["hbm_roofline_ms"] = round(roofline_s * 1e3, 3)
-        out["fraction_of_hbm_roofline"] = round(frac, 3)
+    log_variant("decode", out)
+    if int8_result is not None:
+        out["int8"] = int8_result
     return out
 
 

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 
 from tpu_kubernetes.models.llama import ModelConfig
 from tpu_kubernetes.models.moe import MoEConfig, moe_sublayer
+from tpu_kubernetes.models.quant import is_quantized, weight as _w
 from tpu_kubernetes.ops import (
     apply_rope,
     flash_attention,
@@ -73,13 +74,21 @@ def init_cache(cfg: ModelConfig, batch: int, max_seq: int | None = None) -> KVCa
 
 
 def _mlp(cfg: ModelConfig, x: jax.Array, layer: dict) -> jax.Array:
-    """The post-attention sublayer for either family (residual included)."""
+    """The post-attention sublayer for either family (residual included).
+    Weights are read through the quant accessor, so int8-exported params
+    (models/quant.py) serve through the same code path."""
     if isinstance(cfg, MoEConfig):
+        if any(is_quantized(layer[k]) for k in ("w_gate", "w_up", "w_down")):
+            layer = {**layer, **{
+                k: _w(layer[k], cfg.dtype) for k in ("w_gate", "w_up", "w_down")
+            }}
         out, _ = moe_sublayer(cfg, x, layer)
         return out
     y = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gated = jax.nn.silu(y @ layer["w_gate"]) * (y @ layer["w_up"])
-    return x + gated @ layer["w_down"]
+    gated = jax.nn.silu(y @ _w(layer["w_gate"], cfg.dtype)) * (
+        y @ _w(layer["w_up"], cfg.dtype)
+    )
+    return x + gated @ _w(layer["w_down"], cfg.dtype)
 
 
 def _attend_cache(cfg, q, k_cache, v_cache, valid_len):
@@ -110,9 +119,9 @@ def _decode_block(cfg, cos, sin, pos, li, x, layer, k_all, v_all):
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     y = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (y @ layer["wq"]).reshape(b, 1, h, hd).transpose(0, 2, 1, 3)
-    k = (y @ layer["wk"]).reshape(b, 1, kv, hd).transpose(0, 2, 1, 3)
-    v = (y @ layer["wv"]).reshape(b, 1, kv, hd).transpose(0, 2, 1, 3)
+    q = (y @ _w(layer["wq"], cfg.dtype)).reshape(b, 1, h, hd).transpose(0, 2, 1, 3)
+    k = (y @ _w(layer["wk"], cfg.dtype)).reshape(b, 1, kv, hd).transpose(0, 2, 1, 3)
+    v = (y @ _w(layer["wv"], cfg.dtype)).reshape(b, 1, kv, hd).transpose(0, 2, 1, 3)
     positions = pos[None]                                    # (1,)
     q = apply_rope(q, cos, sin, positions=positions)
     k = apply_rope(k, cos, sin, positions=positions)
@@ -124,7 +133,7 @@ def _decode_block(cfg, cos, sin, pos, li, x, layer, k_all, v_all):
 
     attn = _attend_cache(cfg, q, k_cache, v_cache, pos + 1)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, h * hd)
-    x = x + attn @ layer["wo"]
+    x = x + attn @ _w(layer["wo"], cfg.dtype)
     return _mlp(cfg, x, layer), k_all, v_all
 
 
@@ -143,9 +152,9 @@ def prefill(
 
     def block(x, layer):
         y = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = (y @ layer["wq"]).reshape(b, plen, h, hd).transpose(0, 2, 1, 3)
-        k = (y @ layer["wk"]).reshape(b, plen, kv, hd).transpose(0, 2, 1, 3)
-        v = (y @ layer["wv"]).reshape(b, plen, kv, hd).transpose(0, 2, 1, 3)
+        q = (y @ _w(layer["wq"], cfg.dtype)).reshape(b, plen, h, hd).transpose(0, 2, 1, 3)
+        k = (y @ _w(layer["wk"], cfg.dtype)).reshape(b, plen, kv, hd).transpose(0, 2, 1, 3)
+        v = (y @ _w(layer["wv"], cfg.dtype)).reshape(b, plen, kv, hd).transpose(0, 2, 1, 3)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         # cache this layer's K/V padded out to S
@@ -166,13 +175,13 @@ def prefill(
             use_pallas=cfg.use_pallas,
         )
         attn = attn.transpose(0, 2, 1, 3).reshape(b, plen, h * hd)
-        x = x + attn @ layer["wo"]
+        x = x + attn @ _w(layer["wo"], cfg.dtype)
         return _mlp(cfg, x, layer), (k_full, v_full)
 
     x, (k_cache, v_cache) = jax.lax.scan(block, x, params["layers"])
 
     x = rms_norm(x[:, -1], params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = (x @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
     cache = KVCache(k=k_cache, v=v_cache, length=jnp.asarray(plen, jnp.int32))
     return logits, cache
 
@@ -202,7 +211,7 @@ def decode_step(
     )
 
     x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = (x @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
     return logits, KVCache(k=k_new, v=v_new, length=pos + 1)
 
 
