@@ -28,3 +28,7 @@ from tpu_kubernetes.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ring_attention_sharded,
 )
+from tpu_kubernetes.parallel.serving import (  # noqa: F401
+    make_sharded_generate,
+    serving_param_shardings,
+)

@@ -1,0 +1,100 @@
+"""Sharded (multi-chip) serving: tensor-parallel KV-cache generation.
+
+A 7B+ model doesn't fit one chip's HBM at serving time, and even when it
+does, decode throughput scales with aggregate HBM bandwidth — so serving
+wants the same mesh machinery as training. This module jits
+``models.decode.generate`` over a Mesh with the training stack's own
+logical-axis rules (parallel/mesh.py): attention heads and MLP hidden
+shard over ``tensor``, the batch shards over the data-like axes, and XLA
+inserts the collectives (one psum per attention/MLP block output — the
+standard Megatron-style decode pattern) so they ride ICI.
+
+The KV cache never crosses the API: it is created inside the jitted
+program and XLA propagates shardings onto it from the sharded K/V
+projections (cache kv-heads follow ``tensor``, batch follows data), so
+each chip holds only its slice of the cache.
+
+Works with raw bf16 params or the int8 export (models/quant.py): the
+quantized ``{"q", "s"}`` leaves carry the same logical axes as the
+weights they replace, scales sharded like the output channel they scale.
+
+The reference provisioner has no inference plane (SURVEY §0); this
+completes the serving side of the in-tree stack the same way
+make_sharded_train_step completes training (train/trainer.py:107).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tpu_kubernetes.models import logical_axes
+from tpu_kubernetes.models.decode import generate
+from tpu_kubernetes.models.llama import ModelConfig
+from tpu_kubernetes.models.quant import is_quantized
+from tpu_kubernetes.parallel.mesh import batch_sharding, param_shardings
+
+
+def serving_param_shardings(params: dict, cfg: ModelConfig, mesh: Mesh):
+    """Shardings for a serving pytree: the model's logical axes mapped
+    onto the mesh, extended leaf-wise over int8-quantized ``{"q", "s"}``
+    leaves — ``q`` shards exactly like the weight it replaces, ``s``
+    (shape ``(..., 1, out)``) like the weight with the contraction dim
+    replicated."""
+    axes = logical_axes(cfg)
+    base = param_shardings(axes, mesh)
+
+    def extend(leaf, sharding):
+        if not is_quantized(leaf):
+            return sharding
+        spec = sharding.spec
+        # scale's axis -2 is the kept (size-1) contraction dim — replicate
+        s_spec = PartitionSpec(*spec[:-2], None, spec[-1]) if len(spec) >= 2 \
+            else spec
+        return {
+            "q": sharding,
+            "s": NamedSharding(mesh, s_spec),
+        }
+
+    return jax.tree_util.tree_map(
+        extend, params, base,
+        is_leaf=lambda x: is_quantized(x) or not isinstance(x, dict),
+    )
+
+
+def make_sharded_generate(
+    cfg: ModelConfig, mesh: Mesh, params: dict, *,
+    max_new_tokens: int, temperature: float = 0.0, top_k: int = 0,
+) -> tuple[Callable, Any, NamedSharding]:
+    """→ (generate_fn(params, prompt, rng=None) -> tokens, param
+    shardings, prompt sharding). Mirrors make_sharded_train_step's
+    contract: the caller ``jax.device_put``s params/prompt with the
+    returned shardings and calls the function; tokens come back
+    replicated. ``rng`` feeds the sampler (temperature > 0) — it is part
+    of the compiled signature (replicated) so successive serving calls
+    can actually draw different samples; omitted, it defaults to a fixed
+    key (fine for greedy decoding)."""
+    p_shardings = serving_param_shardings(params, cfg, mesh)
+    prompt_sharding = batch_sharding(mesh)
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def _gen(params, prompt, rng):
+        return generate(
+            params, prompt, cfg, max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k, rng=rng,
+        )
+
+    jitted = jax.jit(
+        _gen,
+        in_shardings=(p_shardings, prompt_sharding, replicated),
+        out_shardings=replicated,
+    )
+
+    def run(params, prompt, rng: jax.Array | None = None):
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return jitted(params, prompt, rng)
+
+    return run, p_shardings, prompt_sharding
