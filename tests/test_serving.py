@@ -48,11 +48,14 @@ def test_quantized_sharded_generate_matches_quantized_single_device():
         qparams["layers"]["wq"]["q"], p_sh["layers"]["wq"]["q"]
     )
     assert q.addressable_shards[0].data.size < q.size
-    # the scale shards with the output channel it scales
+    # the scale shards with the output channel it scales (not replicated,
+    # not split on its size-1 contraction dim)
     s = jax.device_put(
         qparams["layers"]["wq"]["s"], p_sh["layers"]["wq"]["s"]
     )
-    assert s.addressable_shards[0].data.shape[-2] == 1
+    shard = s.addressable_shards[0].data
+    assert shard.shape[-2] == 1
+    assert shard.shape[-1] < s.shape[-1]
 
 
 def test_moe_expert_parallel_generate_matches_single_device():
