@@ -117,6 +117,23 @@ def test_generate_sampling_controls(params):
         rng=jax.random.PRNGKey(3),
     )
     np.testing.assert_array_equal(np.asarray(g), np.asarray(k1))
+    # a vanishingly small nucleus keeps only the argmax token → greedy
+    p_tiny = generate(
+        params, prompt, CFG, max_new_tokens=8, temperature=0.7, top_p=1e-9,
+        rng=jax.random.PRNGKey(4),
+    )
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(p_tiny))
+    # top_p=1 keeps the full distribution — identical draws to no filter
+    # under the same rng
+    full = generate(
+        params, prompt, CFG, max_new_tokens=8, temperature=1.0,
+        rng=jax.random.PRNGKey(5),
+    )
+    p_full = generate(
+        params, prompt, CFG, max_new_tokens=8, temperature=1.0, top_p=1.0,
+        rng=jax.random.PRNGKey(5),
+    )
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(p_full))
 
 
 def test_generate_rejects_overflow(params):

@@ -215,14 +215,30 @@ def decode_step(
     return logits, KVCache(k=k_new, v=v_new, length=pos + 1)
 
 
-def _sample(logits: jax.Array, rng: jax.Array, temperature: float, top_k: int):
-    """(batch, vocab) f32 → (batch,) int32. temperature 0 = greedy."""
+def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
+            top_k: int, top_p: float = 0.0):
+    """(batch, vocab) f32 → (batch,) int32. temperature 0 = greedy.
+    top_k and top_p (nucleus) filters compose — both static, one sort
+    each, no data-dependent shapes (the nucleus is a mask, not a
+    gather)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p > 0.0:
+        # keep the smallest prefix of descending-probability tokens whose
+        # mass reaches top_p; the highest-probability token always stays
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = (cum - probs) < top_p          # exclusive prefix mass
+        # threshold = smallest kept logit, mapped back to the unsorted order
+        cutoff = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1
+        )[:, None]
+        logits = jnp.where(logits < cutoff, -1e30, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -234,6 +250,7 @@ def generate(
     *,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 0.0,
     rng: jax.Array | None = None,
 ) -> jax.Array:
     """prompt (batch, prompt_len) int32 → (batch, max_new_tokens) int32.
@@ -252,12 +269,12 @@ def generate(
     # right-size the cache: decode attends over plen+max_new positions,
     # not cfg.max_seq (static per compile, same as max_new_tokens)
     logits, cache = prefill(params, prompt, cfg, max_seq=plen + max_new_tokens)
-    first = _sample(logits, first_rng, temperature, top_k)
+    first = _sample(logits, first_rng, temperature, top_k, top_p)
 
     def step(carry, step_rng):
         cache, token = carry
         logits, cache = decode_step(params, cache, token, cfg)
-        nxt = _sample(logits, step_rng, temperature, top_k)
+        nxt = _sample(logits, step_rng, temperature, top_k, top_p)
         return (cache, nxt), nxt
 
     rngs = jax.random.split(rng, max_new_tokens - 1)

@@ -67,6 +67,7 @@ def serving_param_shardings(params: dict, cfg: ModelConfig, mesh: Mesh):
 def make_sharded_generate(
     cfg: ModelConfig, mesh: Mesh, params: dict, *,
     max_new_tokens: int, temperature: float = 0.0, top_k: int = 0,
+    top_p: float = 0.0,
 ) -> tuple[Callable, Any, NamedSharding]:
     """→ (generate_fn(params, prompt, rng=None) -> tokens, param
     shardings, prompt sharding). Mirrors make_sharded_train_step's
@@ -83,7 +84,7 @@ def make_sharded_generate(
     def _gen(params, prompt, rng):
         return generate(
             params, prompt, cfg, max_new_tokens=max_new_tokens,
-            temperature=temperature, top_k=top_k, rng=rng,
+            temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
         )
 
     jitted = jax.jit(
