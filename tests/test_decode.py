@@ -147,3 +147,41 @@ def test_moe_generate_runs(moe_params):
     out = generate(moe_params, prompt, MOE, max_new_tokens=4)
     assert out.shape == (2, 4)
     assert (np.asarray(out) >= 0).all() and (np.asarray(out) < MOE.vocab_size).all()
+
+
+def test_ragged_prompts_match_unpadded_rows(params):
+    """A right-padded variable-length batch must generate token-identical
+    to running each row alone at its true length (greedy, f32-exact
+    because each row's masked attention sees exactly the same values)."""
+    lengths = [5, 8]
+    plen = max(lengths)
+    rows = [
+        jax.random.randint(jax.random.PRNGKey(30 + i), (1, n), 0, CFG.vocab_size)
+        for i, n in enumerate(lengths)
+    ]
+    padded = jnp.stack([
+        jnp.pad(r[0], (0, plen - r.shape[1])) for r in rows
+    ])
+    got = generate(
+        params, padded, CFG, max_new_tokens=6,
+        prompt_lengths=jnp.asarray(lengths, jnp.int32),
+    )
+    for i, r in enumerate(rows):
+        ref = generate(params, r, CFG, max_new_tokens=6)
+        np.testing.assert_array_equal(np.asarray(got[i]), np.asarray(ref[0]))
+
+
+def test_eos_stops_a_finished_row(params):
+    prompt = jax.random.randint(jax.random.PRNGKey(40), (2, 6), 0, CFG.vocab_size)
+    free = generate(params, prompt, CFG, max_new_tokens=8)
+    # pick row 0's third token as the "eos" and re-run
+    eos = int(free[0, 2])
+    out = generate(
+        params, prompt, CFG, max_new_tokens=8, eos_id=eos, pad_id=-1
+    )
+    row = np.asarray(out[0]).tolist()
+    k = row.index(eos)
+    assert k <= 2
+    assert all(t == -1 for t in row[k + 1:])
+    # tokens before the stop are unchanged
+    assert row[:k + 1] == np.asarray(free[0, :k + 1]).tolist()

@@ -82,3 +82,21 @@ def test_sampled_generation_uses_the_supplied_rng():
     c = fn(p, prompt, rng=jax.random.PRNGKey(10))
     np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
     assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ragged_sharded_generate_matches_unsharded():
+    mesh = create_mesh({"data": 2, "tensor": 4})
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    lengths = jnp.asarray([3, 7, 5, 7], jnp.int32)
+    prompt = jax.random.randint(jax.random.PRNGKey(8), (4, 7), 0, CFG.vocab_size)
+    ref = generate(
+        params, prompt, CFG, max_new_tokens=5, prompt_lengths=lengths
+    )
+    fn, p_sh, b_sh = make_sharded_generate(
+        CFG, mesh, params, max_new_tokens=5
+    )
+    out = fn(
+        jax.device_put(params, p_sh), jax.device_put(prompt, b_sh),
+        prompt_lengths=lengths,
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

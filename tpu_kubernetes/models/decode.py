@@ -56,11 +56,24 @@ from tpu_kubernetes.ops import (
 
 class KVCache(NamedTuple):
     """Stacked per-layer cache: k/v are (n_layers, batch, kv_heads,
-    max_seq, head_dim); length is the number of valid positions."""
+    max_seq, head_dim); length is the number of filled SLOTS (uniform
+    across the batch — generated tokens always append at slot ``length``).
+
+    Ragged (right-padded) prompt batches additionally carry
+    ``prompt_lengths`` ((batch,) real prompt tokens per row) and
+    ``prompt_slots`` (scalar: the padded prompt width where generation
+    slots begin). Row i's REAL positions are then slots [0, prompt_lengths
+    [i]) ∪ [prompt_slots, length) — decode attention masks everything
+    else (the pad slots hold garbage K/V from prefill), and RoPE positions
+    for generated tokens are per-row (prompt_lengths[i] + t) so each row's
+    relative geometry is gapless even though its cache slots are not.
+    Both None = the uniform case (every slot < length is real)."""
 
     k: jax.Array
     v: jax.Array
     length: jax.Array  # () int32
+    prompt_lengths: jax.Array | None = None  # (batch,) int32
+    prompt_slots: jax.Array | None = None    # () int32
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_seq: int | None = None) -> KVCache:
@@ -91,9 +104,12 @@ def _mlp(cfg: ModelConfig, x: jax.Array, layer: dict) -> jax.Array:
     return x + gated @ _w(layer["w_down"], cfg.dtype)
 
 
-def _attend_cache(cfg, q, k_cache, v_cache, valid_len):
+def _attend_cache(cfg, q, k_cache, v_cache, valid_len,
+                  prompt_lengths=None, prompt_slots=None):
     """Decode-side attention only: q (b, h, 1, d) against the cache
-    (b, kv, S, d); positions ≥ valid_len masked. GQA: query heads are
+    (b, kv, S, d); positions ≥ valid_len masked. For ragged prompt
+    batches the pad slots between a row's real prompt and the uniform
+    generation region are masked too (see KVCache). GQA: query heads are
     grouped over their KV head inside the einsum (no repeated cache).
     Prefill goes through the training flash kernel instead."""
     h, kv = cfg.n_heads, cfg.n_kv_heads
@@ -103,14 +119,23 @@ def _attend_cache(cfg, q, k_cache, v_cache, valid_len):
     s = jnp.einsum(
         "bkrd,bksd->bkrs", qg, k_cache.astype(jnp.float32)
     ) * (1.0 / (cfg.head_dim ** 0.5))
-    mask = jnp.arange(k_cache.shape[2]) < valid_len          # (S,)
-    s = jnp.where(mask[None, None, None, :], s, -1e30)
+    slots = jnp.arange(k_cache.shape[2])
+    mask = slots < valid_len                                 # (S,) | (b, S)
+    if prompt_lengths is not None:
+        mask = mask & (
+            (slots[None, :] < prompt_lengths[:, None])
+            | (slots[None, :] >= prompt_slots)
+        )
+        s = jnp.where(mask[:, None, None, :], s, -1e30)
+    else:
+        s = jnp.where(mask[None, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bkrs,bksd->bkrd", p, v_cache.astype(jnp.float32))
     return out.reshape(b, h, 1, hd).astype(q.dtype)
 
 
-def _decode_block(cfg, cos, sin, pos, li, x, layer, k_all, v_all):
+def _decode_block(cfg, cos, sin, pos, li, x, layer, k_all, v_all,
+                  prompt_lengths=None, prompt_slots=None):
     """One layer, one token. x: (b, 1, d); the FULL stacked cache
     (L, b, kv, S, d) is threaded through and layer ``li``'s slice updated
     in place at ``pos`` (one-position dynamic_update_slice on the scan
@@ -122,7 +147,12 @@ def _decode_block(cfg, cos, sin, pos, li, x, layer, k_all, v_all):
     q = (y @ _w(layer["wq"], cfg.dtype)).reshape(b, 1, h, hd).transpose(0, 2, 1, 3)
     k = (y @ _w(layer["wk"], cfg.dtype)).reshape(b, 1, kv, hd).transpose(0, 2, 1, 3)
     v = (y @ _w(layer["wv"], cfg.dtype)).reshape(b, 1, kv, hd).transpose(0, 2, 1, 3)
-    positions = pos[None]                                    # (1,)
+    if prompt_lengths is not None:
+        # ragged rows: the token in SLOT pos is row i's LOGICAL position
+        # prompt_lengths[i] + (pos - prompt_slots) — gapless per row
+        positions = (prompt_lengths + (pos - prompt_slots))[:, None]  # (b, 1)
+    else:
+        positions = pos[None]                                # (1,)
     q = apply_rope(q, cos, sin, positions=positions)
     k = apply_rope(k, cos, sin, positions=positions)
 
@@ -131,7 +161,8 @@ def _decode_block(cfg, cos, sin, pos, li, x, layer, k_all, v_all):
     k_cache = jax.lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)
     v_cache = jax.lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)
 
-    attn = _attend_cache(cfg, q, k_cache, v_cache, pos + 1)
+    attn = _attend_cache(cfg, q, k_cache, v_cache, pos + 1,
+                         prompt_lengths, prompt_slots)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, h * hd)
     x = x + attn @ _w(layer["wo"], cfg.dtype)
     return _mlp(cfg, x, layer), k_all, v_all
@@ -139,10 +170,17 @@ def _decode_block(cfg, cos, sin, pos, li, x, layer, k_all, v_all):
 
 def prefill(
     params: dict, tokens: jax.Array, cfg: ModelConfig,
-    max_seq: int | None = None,
+    max_seq: int | None = None, lengths: jax.Array | None = None,
 ) -> tuple[jax.Array, KVCache]:
     """Process the whole prompt at once. tokens: (batch, prompt_len) →
-    (last-position logits (batch, vocab) f32, filled cache)."""
+    (last-position logits (batch, vocab) f32, filled cache).
+
+    ``lengths`` ((batch,) int32) marks a RIGHT-padded ragged batch: the
+    returned logits are each row's last REAL position (lengths[i]-1, not
+    prompt_len-1) and the cache records the per-row lengths so decode
+    masks the pad slots. Causality already keeps real tokens blind to the
+    trailing pads; the garbage K/V the pad positions produce is dealt
+    with at decode time (see KVCache)."""
     b, plen = tokens.shape
     S = max_seq or cfg.max_seq
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
@@ -180,9 +218,22 @@ def prefill(
 
     x, (k_cache, v_cache) = jax.lax.scan(block, x, params["layers"])
 
-    x = rms_norm(x[:, -1], params["final_norm"], cfg.norm_eps)
-    logits = (x @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
-    cache = KVCache(k=k_cache, v=v_cache, length=jnp.asarray(plen, jnp.int32))
+    if lengths is None:
+        x_last = x[:, -1]
+        cache = KVCache(
+            k=k_cache, v=v_cache, length=jnp.asarray(plen, jnp.int32)
+        )
+    else:
+        x_last = jnp.take_along_axis(
+            x, (lengths - 1)[:, None, None], axis=1
+        )[:, 0]
+        cache = KVCache(
+            k=k_cache, v=v_cache, length=jnp.asarray(plen, jnp.int32),
+            prompt_lengths=lengths.astype(jnp.int32),
+            prompt_slots=jnp.asarray(plen, jnp.int32),
+        )
+    x_last = rms_norm(x_last, params["final_norm"], cfg.norm_eps)
+    logits = (x_last @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
     return logits, cache
 
 
@@ -199,7 +250,8 @@ def decode_step(
         x, k_all, v_all = carry
         layer, li = xs
         x, k_all, v_all = _decode_block(
-            cfg, cos, sin, pos, li, x, layer, k_all, v_all
+            cfg, cos, sin, pos, li, x, layer, k_all, v_all,
+            cache.prompt_lengths, cache.prompt_slots,
         )
         return (x, k_all, v_all), None
 
@@ -212,7 +264,10 @@ def decode_step(
 
     x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
     logits = (x @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
-    return logits, KVCache(k=k_new, v=v_new, length=pos + 1)
+    return logits, KVCache(
+        k=k_new, v=v_new, length=pos + 1,
+        prompt_lengths=cache.prompt_lengths, prompt_slots=cache.prompt_slots,
+    )
 
 
 def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
@@ -252,11 +307,29 @@ def generate(
     top_k: int = 0,
     top_p: float = 0.0,
     rng: jax.Array | None = None,
+    prompt_lengths: jax.Array | None = None,
+    eos_id: int | None = None,
+    pad_id: int = 0,
 ) -> jax.Array:
     """prompt (batch, prompt_len) int32 → (batch, max_new_tokens) int32.
     Jittable end to end (prefill + lax.scan of decode steps with sampling
     folded in); wrap in jax.jit with static cfg/max_new_tokens for a
-    single compiled serving program."""
+    single compiled serving program.
+
+    ``prompt_lengths`` ((batch,) int32) serves a RIGHT-padded ragged
+    batch: row i's prompt is prompt[i, :prompt_lengths[i]], the rest pad.
+    Each row generates from its own last real token with gapless RoPE
+    positions and pad slots masked out of attention — for dense models,
+    token-identical to generating each row unpadded (see KVCache). For
+    MoE configs the identity is weaker: expert capacity is computed at
+    the padded width, so a real token the unpadded run would capacity-
+    drop can survive here (right-pad causality still guarantees pads
+    never displace real tokens' slots, and rows never affect each other —
+    capacity buckets are per row).
+
+    ``eos_id`` stops a row once it samples that token: later positions
+    emit ``pad_id``. Static shapes (the scan always runs max_new_tokens
+    steps — finished rows just stop contributing tokens)."""
     if rng is None:
         rng = jax.random.PRNGKey(0)
     plen = prompt.shape[1]
@@ -268,15 +341,26 @@ def generate(
     rng, first_rng = jax.random.split(rng)
     # right-size the cache: decode attends over plen+max_new positions,
     # not cfg.max_seq (static per compile, same as max_new_tokens)
-    logits, cache = prefill(params, prompt, cfg, max_seq=plen + max_new_tokens)
+    logits, cache = prefill(
+        params, prompt, cfg, max_seq=plen + max_new_tokens,
+        lengths=prompt_lengths,
+    )
     first = _sample(logits, first_rng, temperature, top_k, top_p)
+    done = jnp.zeros(prompt.shape[0], bool)
+    if eos_id is not None:
+        done = first == eos_id
 
     def step(carry, step_rng):
-        cache, token = carry
+        cache, token, done = carry
         logits, cache = decode_step(params, cache, token, cfg)
         nxt = _sample(logits, step_rng, temperature, top_k, top_p)
-        return (cache, nxt), nxt
+        if eos_id is not None:
+            emitted = jnp.where(done, pad_id, nxt)
+            done = done | (nxt == eos_id)
+        else:
+            emitted = nxt
+        return (cache, nxt, done), emitted
 
     rngs = jax.random.split(rng, max_new_tokens - 1)
-    _, rest = jax.lax.scan(step, (cache, first), rngs)  # (max_new-1, batch)
+    _, rest = jax.lax.scan(step, (cache, first, done), rngs)
     return jnp.concatenate([first[:, None], rest.T], axis=1)

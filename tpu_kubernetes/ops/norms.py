@@ -39,15 +39,21 @@ def apply_rope(
     x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array | None = None
 ) -> jax.Array:
     """Rotate pairs of channels. x: (batch, heads, seq, head_dim);
-    cos/sin: (max_seq, head_dim//2); positions: (seq,) or None for 0..seq-1."""
+    cos/sin: (max_seq, head_dim//2); positions: (seq,) shared across the
+    batch, (batch, seq) per-row (ragged serving batches), or None for
+    0..seq-1."""
     seq = x.shape[2]
     if positions is None:
         cos_t, sin_t = cos[:seq], sin[:seq]
     else:
         cos_t, sin_t = cos[positions], sin[positions]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    cos_t = cos_t[None, None, :, :]
-    sin_t = sin_t[None, None, :, :]
+    if positions is not None and positions.ndim == 2:
+        cos_t = cos_t[:, None, :, :]         # (b, 1, seq, hd/2)
+        sin_t = sin_t[:, None, :, :]
+    else:
+        cos_t = cos_t[None, None, :, :]
+        sin_t = sin_t[None, None, :, :]
     rotated = jnp.concatenate(
         [x1 * cos_t - x2 * sin_t, x1 * sin_t + x2 * cos_t], axis=-1
     )

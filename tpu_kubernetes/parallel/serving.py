@@ -67,24 +67,28 @@ def serving_param_shardings(params: dict, cfg: ModelConfig, mesh: Mesh):
 def make_sharded_generate(
     cfg: ModelConfig, mesh: Mesh, params: dict, *,
     max_new_tokens: int, temperature: float = 0.0, top_k: int = 0,
-    top_p: float = 0.0,
+    top_p: float = 0.0, eos_id: int | None = None, pad_id: int = 0,
 ) -> tuple[Callable, Any, NamedSharding]:
-    """→ (generate_fn(params, prompt, rng=None) -> tokens, param
-    shardings, prompt sharding). Mirrors make_sharded_train_step's
-    contract: the caller ``jax.device_put``s params/prompt with the
-    returned shardings and calls the function; tokens come back
-    replicated. ``rng`` feeds the sampler (temperature > 0) — it is part
-    of the compiled signature (replicated) so successive serving calls
-    can actually draw different samples; omitted, it defaults to a fixed
-    key (fine for greedy decoding)."""
+    """→ (generate_fn(params, prompt, rng=None, prompt_lengths=None) ->
+    tokens, param shardings, prompt sharding). Mirrors
+    make_sharded_train_step's contract: the caller ``jax.device_put``s
+    params/prompt with the returned shardings and calls the function;
+    tokens come back replicated. ``rng`` feeds the sampler
+    (temperature > 0) — it is part of the compiled signature (replicated)
+    so successive serving calls can actually draw different samples;
+    omitted, it defaults to a fixed key (fine for greedy decoding).
+    ``prompt_lengths`` serves a right-padded ragged batch (replicated —
+    it is (batch,) int32, bytes not worth sharding); ``eos_id``/``pad_id``
+    are static per compiled program like the sampling knobs."""
     p_shardings = serving_param_shardings(params, cfg, mesh)
     prompt_sharding = batch_sharding(mesh)
     replicated = NamedSharding(mesh, PartitionSpec())
 
-    def _gen(params, prompt, rng):
+    def _gen(params, prompt, rng, prompt_lengths=None):
         return generate(
             params, prompt, cfg, max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
+            prompt_lengths=prompt_lengths, eos_id=eos_id, pad_id=pad_id,
         )
 
     jitted = jax.jit(
@@ -92,10 +96,18 @@ def make_sharded_generate(
         in_shardings=(p_shardings, prompt_sharding, replicated),
         out_shardings=replicated,
     )
+    jitted_ragged = jax.jit(
+        _gen,
+        in_shardings=(p_shardings, prompt_sharding, replicated, replicated),
+        out_shardings=replicated,
+    )
 
-    def run(params, prompt, rng: jax.Array | None = None):
+    def run(params, prompt, rng: jax.Array | None = None,
+            prompt_lengths: jax.Array | None = None):
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        return jitted(params, prompt, rng)
+        if prompt_lengths is None:
+            return jitted(params, prompt, rng)
+        return jitted_ragged(params, prompt, rng, prompt_lengths)
 
     return run, p_shardings, prompt_sharding
