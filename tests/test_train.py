@@ -120,3 +120,52 @@ def test_graft_dryrun_multichip():
     import __graft_entry__ as graft
 
     graft.dryrun_multichip(8)
+
+
+class TestGradAccumulation:
+    """tc.accum_steps microbatch scanning must reproduce the full-batch
+    step: equal-size micro means average to the full mean, so parameters,
+    optimizer state, and loss match (f32 model → tight tolerances)."""
+
+    def test_accumulated_step_matches_full_batch(self):
+        from dataclasses import replace as _r
+
+        cfg = _r(CONFIGS["llama-test"], dtype=jnp.float32)
+        tc1 = TrainConfig(warmup_steps=2)
+        tc4 = TrainConfig(warmup_steps=2, accum_steps=4)
+        batch = next(synthetic_batches(cfg.vocab_size, 8, 32))
+
+        s1 = init_state(jax.random.PRNGKey(0), cfg, tc1)
+        s4 = init_state(jax.random.PRNGKey(0), cfg, tc4)
+        s1, l1 = jax.jit(lambda s, b: train_step(s, b, cfg, tc1))(s1, batch)
+        s4, l4 = jax.jit(lambda s, b: train_step(s, b, cfg, tc4))(s4, batch)
+
+        np.testing.assert_allclose(float(l1), float(l4), rtol=1e-5)
+        for a, b in zip(
+            jax.tree.leaves(s1["params"]), jax.tree.leaves(s4["params"])
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+            )
+
+    def test_indivisible_batch_rejected(self):
+        cfg = CONFIGS["llama-test"]
+        tc = TrainConfig(warmup_steps=2, accum_steps=3)
+        state = init_state(jax.random.PRNGKey(0), cfg, tc)
+        batch = next(synthetic_batches(cfg.vocab_size, 4, 16))
+        with pytest.raises(ValueError, match="not divisible"):
+            train_step(state, batch, cfg, tc)
+
+    def test_sharded_accumulated_step_runs(self):
+        cfg = CONFIGS["llama-test"]
+        tc = TrainConfig(warmup_steps=2, accum_steps=2)
+        mesh = create_mesh({"data": 2, "fsdp": 2, "tensor": 2})
+        state = init_state(jax.random.PRNGKey(0), cfg, tc)
+        step, sh, b_sh = make_sharded_train_step(cfg, tc, mesh, state)
+        state = jax.device_put(state, sh)
+        batch = jax.device_put(
+            next(synthetic_batches(cfg.vocab_size, 8, 32)), b_sh
+        )
+        state, loss = step(state, batch)
+        assert np.isfinite(float(loss))
+        assert int(state["step"]) == 1

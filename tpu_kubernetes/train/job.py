@@ -13,7 +13,8 @@ JOB_STEPS, JOB_MESH ("data=1,fsdp=16,tensor=1"), JOB_DCN_MESH (multislice:
 cross-slice axes, e.g. "data=2" — JOB_MESH then describes the intra-slice
 ICI axes), JOB_DATA_PATH (token shards; synthetic data when unset),
 JOB_CHECKPOINT_DIR, JOB_CHECKPOINT_EVERY, JOB_EVAL_DATA_PATH +
-JOB_EVAL_EVERY/JOB_EVAL_BATCHES (held-out loss/perplexity).
+JOB_EVAL_EVERY/JOB_EVAL_BATCHES (held-out loss/perplexity),
+JOB_ACCUM_STEPS (gradient accumulation: microbatches per optimizer step).
 """
 
 from __future__ import annotations
@@ -102,7 +103,9 @@ def main() -> None:
     log(f"devices={n} mesh={dict(mesh.shape)} model={model} "
         f"batch={batch} seq={seq}")
 
-    tc = TrainConfig()
+    tc = TrainConfig(
+        accum_steps=int(os.environ.get("JOB_ACCUM_STEPS", "1")),
+    )
     state = init_state(jax.random.PRNGKey(0), cfg, tc)
     log(f"params={param_count(state['params'])/1e9:.2f}B")
     step_fn, shardings, b_sharding = make_sharded_train_step(cfg, tc, mesh, state)

@@ -29,6 +29,11 @@ class TrainConfig:
     beta2: float = 0.95
     grad_clip: float = 1.0
     warmup_steps: int = 100
+    # gradient accumulation: the (global_batch, seq+1) step batch is split
+    # into accum_steps microbatches scanned sequentially, gradients
+    # accumulated in float32, ONE optimizer update — global batches larger
+    # than HBM allows, numerically the full-batch step (equal micro means)
+    accum_steps: int = 1
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
@@ -55,10 +60,48 @@ def train_step(
 ) -> tuple[dict[str, Any], jax.Array]:
     """One optimizer step. batch: (per-global-batch, seq+1) int32 tokens.
     ``loss`` defaults to the model family's loss_fn; the pipelined step
-    passes pipeline_loss_fn here — the optimizer/update logic is shared."""
-    loss_value, grads = jax.value_and_grad(loss or loss_fn)(
-        state["params"], batch, cfg
-    )
+    passes pipeline_loss_fn here — the optimizer/update logic is shared.
+
+    tc.accum_steps > 1 scans that many microbatches (batch rows must
+    divide evenly), accumulating f32 gradients and applying ONE update —
+    the returned loss is the microbatch mean. The token batch is small
+    (int32), so any cross-device resharding of the (accum, micro, seq+1)
+    reshape is noise next to a step's compute."""
+    lossf = loss or loss_fn
+    if tc.accum_steps < 1:
+        # a typo'd JOB_ACCUM_STEPS=0 must not silently disable the
+        # accumulation it was set to provide
+        raise ValueError(f"accum_steps must be >= 1, got {tc.accum_steps}")
+    if tc.accum_steps > 1:
+        rows = batch.shape[0]
+        if rows % tc.accum_steps:
+            raise ValueError(
+                f"batch rows {rows} not divisible by accum_steps "
+                f"{tc.accum_steps}"
+            )
+        micro = batch.reshape(tc.accum_steps, rows // tc.accum_steps, -1)
+
+        def one(carry, mb):
+            gsum, lsum = carry
+            lv, g = jax.value_and_grad(lossf)(state["params"], mb, cfg)
+            gsum = jax.tree.map(
+                lambda a, b: a + b.astype(jnp.float32), gsum, g
+            )
+            return (gsum, lsum + lv), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state["params"]
+        )
+        (gsum, lsum), _ = jax.lax.scan(one, (zeros, jnp.float32(0.0)), micro)
+        scale = 1.0 / tc.accum_steps
+        grads = jax.tree.map(
+            lambda g, p: (g * scale).astype(p.dtype), gsum, state["params"]
+        )
+        loss_value = lsum * scale
+    else:
+        loss_value, grads = jax.value_and_grad(lossf)(
+            state["params"], batch, cfg
+        )
     updates, new_opt = make_optimizer(tc).update(
         grads, state["opt_state"], state["params"]
     )
