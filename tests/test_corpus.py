@@ -1,0 +1,91 @@
+"""Corpus preparation (train/corpus.py): text → token shards consumable
+by the data pipeline, byte tokenizer determinism, sharding boundaries,
+and the CLI surface."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tpu_kubernetes.train.corpus import (
+    build_shards,
+    byte_tokenizer,
+    resolve_tokenizer,
+    token_dtype,
+)
+from tpu_kubernetes.train.data import TokenDataset
+
+
+@pytest.fixture()
+def texts(tmp_path):
+    a = tmp_path / "a.txt"
+    b = tmp_path / "b.txt"
+    a.write_text("hello tpu world\n")
+    b.write_text("ragged prompts and rings\n")
+    return [a, b]
+
+
+def test_byte_tokenizer_roundtrip():
+    encode, vocab = byte_tokenizer()
+    ids = encode("héllo")
+    assert vocab == 256
+    assert bytes(ids).decode("utf-8") == "héllo"
+
+
+def test_token_dtype_contract():
+    assert token_dtype(256) == np.uint16
+    assert token_dtype(65536) == np.uint32
+
+
+def test_build_shards_feeds_the_data_pipeline(tmp_path, texts):
+    out = tmp_path / "shards"
+    paths = build_shards(texts, out, eot_id=0)
+    assert len(paths) == 1
+    raw = np.fromfile(paths[0], dtype=np.uint16)
+    expected = list("hello tpu world\n".encode()) + [0] + \
+        list("ragged prompts and rings\n".encode()) + [0]
+    assert raw.tolist() == expected
+
+    # the data pipeline can serve sequences from what we wrote
+    ds = TokenDataset(out, seq=8, vocab_size=256)
+    assert len(ds) == len(expected) // 9
+    window = ds.sequence(0)
+    assert window.shape == (9,)  # seq + 1 (next-token targets)
+    assert window.tolist() == expected[:9]
+
+
+def test_shard_size_boundary(tmp_path):
+    src = tmp_path / "big.txt"
+    src.write_text("x" * 1000)
+    out = tmp_path / "shards"
+    paths = build_shards([src], out, shard_tokens=256)
+    assert len(paths) == 4  # 1000 = 3×256 + 232
+    sizes = [np.fromfile(p, dtype=np.uint16).size for p in paths]
+    assert sizes == [256, 256, 256, 232]
+
+
+def test_unknown_tokenizer_rejected():
+    with pytest.raises(ValueError, match="unknown tokenizer"):
+        resolve_tokenizer("sentencepiece")
+
+
+def test_cli(tmp_path, texts):
+    out = tmp_path / "cli_shards"
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_kubernetes.train.corpus",
+         "--out", str(out), *map(str, texts)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "wrote 1 shard(s)" in r.stdout
+    assert list(out.glob("*.bin"))
+
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_kubernetes.train.corpus",
+         "--out", str(out), str(tmp_path / "nope.txt")],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    assert "missing input" in r.stderr

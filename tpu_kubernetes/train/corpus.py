@@ -1,0 +1,142 @@
+"""Corpus preparation: raw text → the flat token shards train/data.py
+memory-maps.
+
+Closes the last gap in the data path: ``create cluster`` stands up the
+slice, ``train.job`` consumes ``JOB_DATA_PATH`` shards, and this module
+produces them from text. One shard = one flat little-endian array of
+token ids (uint16 for vocab < 65536 else uint32 — data.py's contract).
+
+Tokenizers, TPU-first pragmatics:
+
+* **byte** (default): UTF-8 bytes as token ids (vocab 256). Zero
+  dependencies, zero downloads, deterministic — the right default for an
+  air-gapped TPU pod (this image has no network egress) and for smoke
+  runs; byte-level LMs are a respectable baseline (e.g. ByT5-style).
+* **hf:<name-or-path>**: any Hugging Face tokenizer already present
+  locally (the transformers package is baked into the TPU image;
+  checkpoints must be pre-staged — the framework never downloads at
+  train time, same philosophy as the airgapped packer images).
+
+CLI::
+
+    python -m tpu_kubernetes.train.corpus --out shards/ file1.txt file2.txt
+    python -m tpu_kubernetes.train.corpus --tokenizer hf:/path/to/tok ...
+
+Reference anchor: none — the reference provisioner has no data plane
+(SURVEY §5.4); this belongs to the in-tree training stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import Callable, Iterable
+
+
+def byte_tokenizer() -> tuple[Callable[[str], list[int]], int]:
+    """→ (encode, vocab_size): UTF-8 byte-level tokenization."""
+    return (lambda text: list(text.encode("utf-8"))), 256
+
+
+def hf_tokenizer(name_or_path: str) -> tuple[Callable[[str], list[int]], int]:
+    """→ (encode, vocab_size) from a LOCALLY available HF tokenizer."""
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(name_or_path, local_files_only=True)
+    return (lambda text: tok.encode(text, add_special_tokens=False)), len(tok)
+
+
+def resolve_tokenizer(spec: str) -> tuple[Callable[[str], list[int]], int]:
+    if spec == "byte":
+        return byte_tokenizer()
+    if spec.startswith("hf:"):
+        return hf_tokenizer(spec[3:])
+    raise ValueError(f"unknown tokenizer {spec!r} (use 'byte' or 'hf:<path>')")
+
+
+def token_dtype(vocab_size: int):
+    """data.py's width contract: uint16 while it fits, else uint32."""
+    import numpy as np
+
+    return np.uint16 if vocab_size < 65536 else np.uint32
+
+
+def write_shard(
+    tokens: Iterable[int], path: Path, vocab_size: int
+) -> int:
+    """Append-free single-shard write → number of tokens written."""
+    import numpy as np
+
+    arr = np.asarray(list(tokens), dtype=token_dtype(vocab_size))
+    path.parent.mkdir(parents=True, exist_ok=True)
+    arr.tofile(path)
+    return int(arr.size)
+
+
+def build_shards(
+    inputs: list[Path], out_dir: Path, tokenizer: str = "byte",
+    shard_tokens: int = 64 * 1024 * 1024, eot_id: int | None = None,
+) -> list[Path]:
+    """Tokenize ``inputs`` (text files, read in order) into
+    ``out_dir/shard_{i:05d}.bin`` files of at most ``shard_tokens`` tokens.
+    ``eot_id`` (document separator) is appended after each input file when
+    given. Returns the shard paths written."""
+    encode, vocab = resolve_tokenizer(tokenizer)
+    paths: list[Path] = []
+    buf: list[int] = []
+
+    def flush() -> None:
+        if not buf:
+            return
+        p = out_dir / f"shard_{len(paths):05d}.bin"
+        write_shard(buf, p, vocab)
+        paths.append(p)
+        buf.clear()
+
+    for src in inputs:
+        ids = encode(src.read_text(encoding="utf-8"))
+        if eot_id is not None:
+            ids = list(ids) + [eot_id]
+        buf.extend(ids)
+        while len(buf) >= shard_tokens:
+            head, rest = buf[:shard_tokens], buf[shard_tokens:]
+            buf[:] = head
+            flush()
+            buf[:] = rest
+    flush()
+    return paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_kubernetes.train.corpus",
+        description="Tokenize text files into flat token shards "
+                    "(train/data.py's input format).",
+    )
+    ap.add_argument("inputs", nargs="+", type=Path, help="text files")
+    ap.add_argument("--out", type=Path, required=True, help="shard directory")
+    ap.add_argument("--tokenizer", default="byte",
+                    help="'byte' (default) or 'hf:<local name-or-path>'")
+    ap.add_argument("--shard-tokens", type=int, default=64 * 1024 * 1024,
+                    help="max tokens per shard (default 64M)")
+    ap.add_argument("--eot-id", type=int, default=None,
+                    help="optional end-of-text token appended per input file")
+    args = ap.parse_args(argv)
+
+    missing = [p for p in args.inputs if not p.is_file()]
+    if missing:
+        print(f"error: missing input file(s): "
+              f"{', '.join(map(str, missing))}", file=sys.stderr)
+        return 1
+    paths = build_shards(
+        args.inputs, args.out, tokenizer=args.tokenizer,
+        shard_tokens=args.shard_tokens, eot_id=args.eot_id,
+    )
+    total = sum(p.stat().st_size for p in paths)
+    print(f"wrote {len(paths)} shard(s), {total} bytes → {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,6 +106,13 @@ def main() -> None:
     tc = TrainConfig(
         accum_steps=int(os.environ.get("JOB_ACCUM_STEPS", "1")),
     )
+    if tc.accum_steps < 1 or batch % tc.accum_steps:
+        # fail in seconds, not after a 7B init on the pod — train_step
+        # would reject this anyway, but only at first trace
+        raise SystemExit(
+            f"JOB_BATCH={batch} not divisible by "
+            f"JOB_ACCUM_STEPS={tc.accum_steps} (or accum < 1)"
+        )
     state = init_state(jax.random.PRNGKey(0), cfg, tc)
     log(f"params={param_count(state['params'])/1e9:.2f}B")
     step_fn, shardings, b_sharding = make_sharded_train_step(cfg, tc, mesh, state)
