@@ -1,0 +1,83 @@
+"""HF Llama interop (models/convert_hf.py): the converted pytree must
+reproduce the transformers reference forward logit-for-logit — the
+strongest external check of the whole model implementation (attention
+scaling, GQA grouping, RoPE convention, SwiGLU, norms)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from transformers import LlamaConfig, LlamaForCausalLM  # noqa: E402
+
+from tpu_kubernetes.models import forward, generate, param_count  # noqa: E402
+from tpu_kubernetes.models.convert_hf import (  # noqa: E402
+    ConvertError,
+    config_from_hf,
+    load_hf_llama,
+    params_from_hf_state_dict,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    torch.manual_seed(0)
+    return LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False,
+        attention_bias=False,
+    )).eval()
+
+
+def test_config_mapping(hf_model):
+    cfg = config_from_hf(hf_model.config, dtype=jnp.float32)
+    assert (cfg.vocab_size, cfg.d_model, cfg.n_layers) == (256, 64, 2)
+    assert (cfg.n_heads, cfg.n_kv_heads, cfg.d_ff) == (4, 2, 128)
+
+
+def test_logit_parity_with_transformers(hf_model):
+    params, cfg = load_hf_llama(hf_model, dtype=jnp.float32)
+    assert param_count(params) == sum(
+        p.numel() for p in hf_model.parameters()
+    )
+    tokens = np.random.default_rng(0).integers(0, 256, (2, 17))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+    got = np.asarray(forward(params, jnp.asarray(tokens), cfg))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-3)
+
+
+def test_greedy_generation_matches_transformers(hf_model):
+    params, cfg = load_hf_llama(hf_model, dtype=jnp.float32)
+    prompt = np.random.default_rng(1).integers(0, 256, (1, 8))
+    with torch.no_grad():
+        ref = hf_model.generate(
+            torch.tensor(prompt), max_new_tokens=6, do_sample=False,
+            pad_token_id=0,
+        ).numpy()[:, 8:]
+    got = np.asarray(generate(
+        params, jnp.asarray(prompt), cfg, max_new_tokens=6
+    ))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_tied_embeddings_fall_back_to_embed(hf_model):
+    sd = {k: v for k, v in hf_model.state_dict().items()
+          if k != "lm_head.weight"}
+    cfg = config_from_hf(hf_model.config, dtype=jnp.float32)
+    params = params_from_hf_state_dict(sd, cfg)
+    np.testing.assert_array_equal(
+        np.asarray(params["lm_head"]), np.asarray(params["embed"]).T
+    )
+
+
+def test_truncated_checkpoint_rejected(hf_model):
+    sd = dict(hf_model.state_dict())
+    del sd["model.layers.1.mlp.up_proj.weight"]
+    cfg = config_from_hf(hf_model.config, dtype=jnp.float32)
+    with pytest.raises(ConvertError, match="missing"):
+        params_from_hf_state_dict(sd, cfg)

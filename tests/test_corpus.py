@@ -89,3 +89,15 @@ def test_cli(tmp_path, texts):
     )
     assert r.returncode == 1
     assert "missing input" in r.stderr
+
+
+def test_eot_out_of_vocab_rejected(tmp_path, texts):
+    with pytest.raises(ValueError, match="out of range"):
+        build_shards(texts, tmp_path / "s", eot_id=256)
+
+
+def test_stale_shards_refused(tmp_path, texts):
+    out = tmp_path / "shards2"
+    build_shards(texts, out)
+    with pytest.raises(ValueError, match="already holds"):
+        build_shards(texts, out)

@@ -1,0 +1,142 @@
+"""Hugging Face Llama checkpoint → this framework's param pytree.
+
+Makes the serving/training stack consumable with real pretrained weights:
+``transformers`` Llama checkpoints (the de-facto interchange format,
+plain-RoPE/no-bias variants — anything else is refused loudly) map
+1:1 onto models/llama.py's pytree — HF ``nn.Linear`` stores
+``(out_features, in_features)``, ours are ``(in, out)``, so every matmul
+weight transposes; per-layer tensors stack on a leading axis for the
+``lax.scan`` block. RoPE conventions agree (rotate-half; HF duplicates
+the (seq, head_dim/2) table across both halves, ops/norms.py applies the
+halves directly), verified by the logit-parity test against the HF
+reference forward (tests/test_convert_hf.py).
+
+Loading never touches the network: pass a live ``transformers`` model, a
+state dict, or a LOCAL checkpoint directory (``local_files_only=True`` —
+the TPU images are air-gapped by design, weights are pre-staged the same
+way the packer images pre-stage container images).
+
+The reference provisioner has no model zoo (SURVEY §0); this belongs to
+the in-tree stack's interop surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_kubernetes.models.llama import ModelConfig
+
+
+class ConvertError(Exception):
+    pass
+
+
+def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> ModelConfig:
+    """transformers LlamaConfig → ModelConfig (shape fields only).
+
+    Refuses configs the in-tree model cannot represent — silently wrong
+    logits are worse than a loud failure."""
+    if getattr(hf_config, "rope_scaling", None):
+        raise ConvertError(
+            "rope_scaling is set (Llama 3.1+ style NTK/linear scaling); "
+            "the in-tree model implements plain RoPE only"
+        )
+    if getattr(hf_config, "attention_bias", False):
+        raise ConvertError(
+            "attention_bias=True checkpoints carry q/k/v/o bias tensors "
+            "the in-tree model has no slot for"
+        )
+    return ModelConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(
+            hf_config, "num_key_value_heads", hf_config.num_attention_heads
+        ),
+        d_ff=hf_config.intermediate_size,
+        max_seq=hf_config.max_position_embeddings,
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        norm_eps=hf_config.rms_norm_eps,
+        dtype=dtype,
+    )
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor | ndarray → float32 ndarray (host)."""
+    if hasattr(t, "detach"):
+        t = t.detach().to("cpu").float().numpy()
+    return np.asarray(t, np.float32)
+
+
+def params_from_hf_state_dict(
+    state_dict: Mapping[str, Any], cfg: ModelConfig
+) -> dict:
+    """HF Llama ``state_dict`` → models/llama.py param pytree in
+    ``cfg.dtype``. Raises ConvertError on missing keys (a truncated or
+    non-Llama checkpoint) — silently wrong weights are worse than a
+    loud failure."""
+    def get(key: str) -> np.ndarray:
+        if key not in state_dict:
+            raise ConvertError(f"checkpoint is missing {key!r}")
+        return _np(state_dict[key])
+
+    def linear(key: str) -> np.ndarray:
+        return get(key).T  # (out, in) → (in, out)
+
+    def stack(fmt: str, transpose: bool) -> jnp.ndarray:
+        rows = [
+            (linear if transpose else get)(fmt.format(i))
+            for i in range(cfg.n_layers)
+        ]
+        return jnp.asarray(np.stack(rows), cfg.dtype)
+
+    embed = get("model.embed_tokens.weight")
+    if "lm_head.weight" in state_dict:
+        lm_head = linear("lm_head.weight")
+    else:
+        lm_head = embed.T  # tie_word_embeddings
+    params = {
+        "embed": jnp.asarray(embed, cfg.dtype),
+        "layers": {
+            "attn_norm": stack(
+                "model.layers.{}.input_layernorm.weight", transpose=False
+            ),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight", True),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight", True),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight", True),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight", True),
+            "mlp_norm": stack(
+                "model.layers.{}.post_attention_layernorm.weight", False
+            ),
+            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight", True),
+            "w_up": stack("model.layers.{}.mlp.up_proj.weight", True),
+            "w_down": stack("model.layers.{}.mlp.down_proj.weight", True),
+        },
+        "final_norm": jnp.asarray(get("model.norm.weight"), cfg.dtype),
+        "lm_head": jnp.asarray(lm_head, cfg.dtype),
+    }
+    return params
+
+
+def load_hf_llama(
+    model_or_path: Any, dtype: Any = jnp.bfloat16
+) -> tuple[dict, ModelConfig]:
+    """One-call interop: a live ``transformers`` Llama model OR a local
+    checkpoint path → (params, cfg). Network access is never attempted."""
+    if isinstance(model_or_path, (str, bytes)) or hasattr(
+        model_or_path, "__fspath__"
+    ):
+        import torch  # noqa: F401 — transformers needs it for weights
+        from transformers import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_pretrained(
+            model_or_path, local_files_only=True
+        )
+    else:
+        model = model_or_path
+    cfg = config_from_hf(model.config, dtype=dtype)
+    return params_from_hf_state_dict(model.state_dict(), cfg), cfg

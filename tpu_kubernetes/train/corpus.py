@@ -31,7 +31,7 @@ from __future__ import annotations
 import argparse
 import sys
 from pathlib import Path
-from typing import Callable, Iterable
+from typing import Any, Callable
 
 
 def byte_tokenizer() -> tuple[Callable[[str], list[int]], int]:
@@ -62,18 +62,6 @@ def token_dtype(vocab_size: int):
     return np.uint16 if vocab_size < 65536 else np.uint32
 
 
-def write_shard(
-    tokens: Iterable[int], path: Path, vocab_size: int
-) -> int:
-    """Append-free single-shard write → number of tokens written."""
-    import numpy as np
-
-    arr = np.asarray(list(tokens), dtype=token_dtype(vocab_size))
-    path.parent.mkdir(parents=True, exist_ok=True)
-    arr.tofile(path)
-    return int(arr.size)
-
-
 def build_shards(
     inputs: list[Path], out_dir: Path, tokenizer: str = "byte",
     shard_tokens: int = 64 * 1024 * 1024, eot_id: int | None = None,
@@ -81,30 +69,51 @@ def build_shards(
     """Tokenize ``inputs`` (text files, read in order) into
     ``out_dir/shard_{i:05d}.bin`` files of at most ``shard_tokens`` tokens.
     ``eot_id`` (document separator) is appended after each input file when
-    given. Returns the shard paths written."""
-    encode, vocab = resolve_tokenizer(tokenizer)
-    paths: list[Path] = []
-    buf: list[int] = []
+    given and must be in-vocab. Refuses an out_dir that already holds
+    shards: TokenDataset globs ``*.bin``, so stale shards from a previous
+    run would silently mix into training data. Returns the paths written.
 
-    def flush() -> None:
-        if not buf:
-            return
+    Tokens buffer as numpy arrays in the shard dtype (a 64M-token shard
+    is ~128 MB, not the gigabytes a Python int list would cost)."""
+    import numpy as np
+
+    encode, vocab = resolve_tokenizer(tokenizer)
+    if eot_id is not None and not 0 <= eot_id < vocab:
+        raise ValueError(
+            f"eot_id {eot_id} out of range for vocab {vocab} — training "
+            "would silently clip it into a real token"
+        )
+    stale = sorted(out_dir.glob("*.bin")) if out_dir.is_dir() else []
+    if stale:
+        raise ValueError(
+            f"{out_dir} already holds {len(stale)} .bin shard(s) — "
+            "remove them or pick a fresh directory (the data pipeline "
+            "would silently read them as training data)"
+        )
+    dtype = token_dtype(vocab)
+    paths: list[Path] = []
+    chunks: list[Any] = []
+    buffered = 0
+
+    def flush(arr) -> None:
         p = out_dir / f"shard_{len(paths):05d}.bin"
-        write_shard(buf, p, vocab)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        arr.tofile(p)
         paths.append(p)
-        buf.clear()
 
     for src in inputs:
         ids = encode(src.read_text(encoding="utf-8"))
         if eot_id is not None:
             ids = list(ids) + [eot_id]
-        buf.extend(ids)
-        while len(buf) >= shard_tokens:
-            head, rest = buf[:shard_tokens], buf[shard_tokens:]
-            buf[:] = head
-            flush()
-            buf[:] = rest
-    flush()
+        chunks.append(np.asarray(ids, dtype))
+        buffered += chunks[-1].size
+        while buffered >= shard_tokens:
+            flat = np.concatenate(chunks)
+            flush(flat[:shard_tokens])
+            chunks = [flat[shard_tokens:]]
+            buffered = chunks[0].size
+    if buffered:
+        flush(np.concatenate(chunks))
     return paths
 
 
@@ -129,10 +138,14 @@ def main(argv: list[str] | None = None) -> int:
         print(f"error: missing input file(s): "
               f"{', '.join(map(str, missing))}", file=sys.stderr)
         return 1
-    paths = build_shards(
-        args.inputs, args.out, tokenizer=args.tokenizer,
-        shard_tokens=args.shard_tokens, eot_id=args.eot_id,
-    )
+    try:
+        paths = build_shards(
+            args.inputs, args.out, tokenizer=args.tokenizer,
+            shard_tokens=args.shard_tokens, eot_id=args.eot_id,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     total = sum(p.stat().st_size for p in paths)
     print(f"wrote {len(paths)} shard(s), {total} bytes → {args.out}")
     return 0
