@@ -17,6 +17,7 @@ from tpu_kubernetes.models import forward, generate, param_count  # noqa: E402
 from tpu_kubernetes.models.convert_hf import (  # noqa: E402
     ConvertError,
     config_from_hf,
+    load_hf,
     load_hf_llama,
     params_from_hf_state_dict,
 )
@@ -81,3 +82,45 @@ def test_truncated_checkpoint_rejected(hf_model):
     cfg = config_from_hf(hf_model.config, dtype=jnp.float32)
     with pytest.raises(ConvertError, match="missing"):
         params_from_hf_state_dict(sd, cfg)
+
+
+class TestMixtral:
+    @pytest.fixture(scope="class")
+    def hf_moe(self):
+        from transformers import MixtralConfig, MixtralForCausalLM
+
+        torch.manual_seed(1)
+        return MixtralForCausalLM(MixtralConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, num_local_experts=4,
+            num_experts_per_tok=2, max_position_embeddings=128,
+            tie_word_embeddings=False,
+        )).eval()
+
+    def test_logit_parity_with_transformers(self, hf_moe):
+        params, cfg = load_hf(hf_moe, dtype=jnp.float32)
+        assert cfg.n_experts == 4 and cfg.experts_per_token == 2
+        # the converted config is dropless (HF Mixtral has no capacity
+        # concept), so parity holds on the config exactly as loaded
+        assert cfg.capacity_factor == float(cfg.n_experts)
+        tokens = np.random.default_rng(2).integers(0, 256, (2, 15))
+        with torch.no_grad():
+            ref = hf_moe(torch.tensor(tokens)).logits.numpy()
+        got = np.asarray(forward(params, jnp.asarray(tokens), cfg))
+        np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-3)
+
+    def test_llama_alias_rejects_moe(self, hf_moe):
+        with pytest.raises(ConvertError, match="use load_hf"):
+            load_hf_llama(hf_moe, dtype=jnp.float32)
+
+    def test_sliding_window_refused(self, hf_moe):
+        from tpu_kubernetes.models.convert_hf import moe_config_from_hf
+
+        cfg = hf_moe.config
+        cfg.sliding_window = 64  # < max_position_embeddings=128
+        try:
+            with pytest.raises(ConvertError, match="sliding_window"):
+                moe_config_from_hf(cfg, dtype=jnp.float32)
+        finally:
+            cfg.sliding_window = None

@@ -24,7 +24,10 @@ from tpu_kubernetes.models.decode import (  # noqa: F401
 from tpu_kubernetes.models.llama import ModelConfig  # noqa: F401
 from tpu_kubernetes.models.llama import param_count  # noqa: F401
 from tpu_kubernetes.models.moe import MoEConfig, expert_capacity  # noqa: F401
-from tpu_kubernetes.models.convert_hf import load_hf_llama  # noqa: F401
+from tpu_kubernetes.models.convert_hf import (  # noqa: F401
+    load_hf,
+    load_hf_llama,
+)
 from tpu_kubernetes.models.quant import (  # noqa: F401
     quantize_for_decode,
     quantized_param_bytes,

@@ -1,15 +1,19 @@
-"""Hugging Face Llama checkpoint → this framework's param pytree.
+"""Hugging Face checkpoint → this framework's param pytrees.
 
 Makes the serving/training stack consumable with real pretrained weights:
-``transformers`` Llama checkpoints (the de-facto interchange format,
-plain-RoPE/no-bias variants — anything else is refused loudly) map
-1:1 onto models/llama.py's pytree — HF ``nn.Linear`` stores
-``(out_features, in_features)``, ours are ``(in, out)``, so every matmul
-weight transposes; per-layer tensors stack on a leading axis for the
-``lax.scan`` block. RoPE conventions agree (rotate-half; HF duplicates
-the (seq, head_dim/2) table across both halves, ops/norms.py applies the
-halves directly), verified by the logit-parity test against the HF
-reference forward (tests/test_convert_hf.py).
+``transformers`` **Llama** (dense) and **Mixtral** (MoE) checkpoints —
+the de-facto interchange formats — map 1:1 onto models/llama.py's and
+models/moe.py's pytrees. HF ``nn.Linear`` stores ``(out_features,
+in_features)``, ours are ``(in, out)``, so every matmul weight
+transposes; per-layer tensors stack on a leading axis for the
+``lax.scan`` block; Mixtral expert weights additionally stack on an
+expert axis (HF's w1/w3/w2 are gate/up/down). RoPE conventions agree
+(rotate-half; HF duplicates the (seq, head_dim/2) table across both
+halves, ops/norms.py applies the halves directly). Anything the in-tree
+models cannot represent — rope_scaling, attention bias, sliding-window
+attention — is refused loudly: silently wrong logits are worse than a
+failed load. Verified logit-for-logit against the HF reference forwards
+(tests/test_convert_hf.py).
 
 Loading never touches the network: pass a live ``transformers`` model, a
 state dict, or a LOCAL checkpoint directory (``local_files_only=True`` —
@@ -22,34 +26,41 @@ the in-tree stack's interop surface.
 
 from __future__ import annotations
 
-from typing import Any, Mapping
+from typing import Any, Callable, Mapping
 
 import jax.numpy as jnp
 import numpy as np
 
 from tpu_kubernetes.models.llama import ModelConfig
+from tpu_kubernetes.models.moe import MoEConfig
 
 
 class ConvertError(Exception):
     pass
 
 
-def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> ModelConfig:
-    """transformers LlamaConfig → ModelConfig (shape fields only).
-
-    Refuses configs the in-tree model cannot represent — silently wrong
-    logits are worse than a loud failure."""
+def _shared_config_fields(hf_config: Any, dtype: Any) -> dict:
+    """The transformer-backbone fields both families share, with the
+    representability guards applied once (silently wrong logits are worse
+    than a loud failure)."""
     if getattr(hf_config, "rope_scaling", None):
         raise ConvertError(
             "rope_scaling is set (Llama 3.1+ style NTK/linear scaling); "
-            "the in-tree model implements plain RoPE only"
+            "the in-tree models implement plain RoPE only"
         )
     if getattr(hf_config, "attention_bias", False):
         raise ConvertError(
             "attention_bias=True checkpoints carry q/k/v/o bias tensors "
-            "the in-tree model has no slot for"
+            "the in-tree models have no slot for"
         )
-    return ModelConfig(
+    window = getattr(hf_config, "sliding_window", None)
+    if window and window < hf_config.max_position_embeddings:
+        raise ConvertError(
+            f"sliding_window={window} < max_position_embeddings="
+            f"{hf_config.max_position_embeddings}: the in-tree models are "
+            "full-causal, logits would silently diverge past the window"
+        )
+    return dict(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
         n_layers=hf_config.num_hidden_layers,
@@ -65,6 +76,28 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> ModelConfig:
     )
 
 
+def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> ModelConfig:
+    """transformers LlamaConfig → ModelConfig (shape fields only)."""
+    return ModelConfig(**_shared_config_fields(hf_config, dtype))
+
+
+def moe_config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> MoEConfig:
+    """transformers MixtralConfig → MoEConfig. Combine-weight semantics
+    agree exactly (HF softmaxes the top-k logits; this model softmaxes all
+    and renormalizes over the selected — the common denominator cancels).
+    HF Mixtral has no capacity concept, so the converted config is
+    DROPLESS (capacity_factor = n_experts) — the regime in which logits
+    match the HF reference exactly; training users wanting capacity
+    batching lower it explicitly."""
+    shared = _shared_config_fields(hf_config, dtype)
+    return MoEConfig(
+        **shared,
+        n_experts=hf_config.num_local_experts,
+        experts_per_token=hf_config.num_experts_per_tok,
+        capacity_factor=float(hf_config.num_local_experts),
+    )
+
+
 def _np(t) -> np.ndarray:
     """torch tensor | ndarray → float32 ndarray (host)."""
     if hasattr(t, "detach"):
@@ -72,71 +105,148 @@ def _np(t) -> np.ndarray:
     return np.asarray(t, np.float32)
 
 
-def params_from_hf_state_dict(
-    state_dict: Mapping[str, Any], cfg: ModelConfig
-) -> dict:
-    """HF Llama ``state_dict`` → models/llama.py param pytree in
-    ``cfg.dtype``. Raises ConvertError on missing keys (a truncated or
-    non-Llama checkpoint) — silently wrong weights are worse than a
-    loud failure."""
+def _getter(state_dict: Mapping[str, Any]) -> Callable[[str], np.ndarray]:
     def get(key: str) -> np.ndarray:
         if key not in state_dict:
             raise ConvertError(f"checkpoint is missing {key!r}")
         return _np(state_dict[key])
 
-    def linear(key: str) -> np.ndarray:
-        return get(key).T  # (out, in) → (in, out)
+    return get
+
+
+def _backbone_params(
+    state_dict: Mapping[str, Any], cfg: ModelConfig
+) -> tuple[dict, dict, Callable]:
+    """The attention/norm/embedding weights both families share →
+    (top-level params, layer dict to extend, the getter). Handles the
+    tied-embedding fallback (no lm_head.weight → embed.T)."""
+    get = _getter(state_dict)
 
     def stack(fmt: str, transpose: bool) -> jnp.ndarray:
-        rows = [
-            (linear if transpose else get)(fmt.format(i))
-            for i in range(cfg.n_layers)
-        ]
+        rows = [get(fmt.format(i)) for i in range(cfg.n_layers)]
+        if transpose:
+            rows = [r.T for r in rows]  # (out, in) → (in, out)
         return jnp.asarray(np.stack(rows), cfg.dtype)
 
     embed = get("model.embed_tokens.weight")
-    if "lm_head.weight" in state_dict:
-        lm_head = linear("lm_head.weight")
-    else:
-        lm_head = embed.T  # tie_word_embeddings
-    params = {
+    lm_head = (
+        get("lm_head.weight").T if "lm_head.weight" in state_dict
+        else embed.T  # tie_word_embeddings
+    )
+    layers = {
+        "attn_norm": stack(
+            "model.layers.{}.input_layernorm.weight", transpose=False
+        ),
+        "wq": stack("model.layers.{}.self_attn.q_proj.weight", True),
+        "wk": stack("model.layers.{}.self_attn.k_proj.weight", True),
+        "wv": stack("model.layers.{}.self_attn.v_proj.weight", True),
+        "wo": stack("model.layers.{}.self_attn.o_proj.weight", True),
+        "mlp_norm": stack(
+            "model.layers.{}.post_attention_layernorm.weight", False
+        ),
+    }
+    top = {
         "embed": jnp.asarray(embed, cfg.dtype),
-        "layers": {
-            "attn_norm": stack(
-                "model.layers.{}.input_layernorm.weight", transpose=False
-            ),
-            "wq": stack("model.layers.{}.self_attn.q_proj.weight", True),
-            "wk": stack("model.layers.{}.self_attn.k_proj.weight", True),
-            "wv": stack("model.layers.{}.self_attn.v_proj.weight", True),
-            "wo": stack("model.layers.{}.self_attn.o_proj.weight", True),
-            "mlp_norm": stack(
-                "model.layers.{}.post_attention_layernorm.weight", False
-            ),
-            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight", True),
-            "w_up": stack("model.layers.{}.mlp.up_proj.weight", True),
-            "w_down": stack("model.layers.{}.mlp.down_proj.weight", True),
-        },
         "final_norm": jnp.asarray(get("model.norm.weight"), cfg.dtype),
         "lm_head": jnp.asarray(lm_head, cfg.dtype),
     }
-    return params
+    return top, layers, get
 
 
-def load_hf_llama(
-    model_or_path: Any, dtype: Any = jnp.bfloat16
-) -> tuple[dict, ModelConfig]:
-    """One-call interop: a live ``transformers`` Llama model OR a local
-    checkpoint path → (params, cfg). Network access is never attempted."""
+def params_from_hf_state_dict(
+    state_dict: Mapping[str, Any], cfg: ModelConfig
+) -> dict:
+    """HF Llama ``state_dict`` → models/llama.py param pytree in
+    ``cfg.dtype``. Raises ConvertError on missing keys (a truncated or
+    non-Llama checkpoint)."""
+    top, layers, get = _backbone_params(state_dict, cfg)
+
+    def stack(fmt: str) -> jnp.ndarray:
+        return jnp.asarray(np.stack([
+            get(fmt.format(i)).T for i in range(cfg.n_layers)
+        ]), cfg.dtype)
+
+    layers.update({
+        "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
+        "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
+        "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
+    })
+    return {**top, "layers": layers}
+
+
+def params_from_hf_mixtral_state_dict(
+    state_dict: Mapping[str, Any], cfg: MoEConfig
+) -> dict:
+    """HF Mixtral ``state_dict`` → models/moe.py param pytree. Expert
+    weights stack (layer, expert, …); HF's w1/w3/w2 are gate/up/down."""
+    top, layers, get = _backbone_params(state_dict, cfg)
+
+    def stack_experts(which: str) -> jnp.ndarray:
+        per_layer = [
+            np.stack([
+                get(
+                    f"model.layers.{i}.block_sparse_moe.experts.{e}."
+                    f"{which}.weight"
+                ).T
+                for e in range(cfg.n_experts)
+            ])
+            for i in range(cfg.n_layers)
+        ]
+        return jnp.asarray(np.stack(per_layer), cfg.dtype)
+
+    layers.update({
+        # router stays float32 (models/moe.py: routing is
+        # precision-sensitive)
+        "w_router": jnp.asarray(np.stack([
+            get(f"model.layers.{i}.block_sparse_moe.gate.weight").T
+            for i in range(cfg.n_layers)
+        ]), jnp.float32),
+        "w_gate": stack_experts("w1"),
+        "w_up": stack_experts("w3"),
+        "w_down": stack_experts("w2"),
+    })
+    return {**top, "layers": layers}
+
+
+def _resolve_model(model_or_path: Any):
+    """Path-or-model → live transformers model (local files only)."""
     if isinstance(model_or_path, (str, bytes)) or hasattr(
         model_or_path, "__fspath__"
     ):
         import torch  # noqa: F401 — transformers needs it for weights
         from transformers import AutoModelForCausalLM
 
-        model = AutoModelForCausalLM.from_pretrained(
+        return AutoModelForCausalLM.from_pretrained(
             model_or_path, local_files_only=True
         )
-    else:
-        model = model_or_path
-    cfg = config_from_hf(model.config, dtype=dtype)
-    return params_from_hf_state_dict(model.state_dict(), cfg), cfg
+    return model_or_path
+
+
+def load_hf(
+    model_or_path: Any, dtype: Any = jnp.bfloat16
+) -> tuple[dict, ModelConfig]:
+    """One-call interop: a live ``transformers`` model OR a local
+    checkpoint path → (params, cfg), dispatched on the config's
+    ``model_type`` (llama → dense, mixtral → MoE). Network access is
+    never attempted."""
+    model = _resolve_model(model_or_path)
+    kind = getattr(model.config, "model_type", "llama")
+    if kind == "mixtral":
+        cfg = moe_config_from_hf(model.config, dtype=dtype)
+        return params_from_hf_mixtral_state_dict(model.state_dict(), cfg), cfg
+    if kind == "llama":
+        cfg = config_from_hf(model.config, dtype=dtype)
+        return params_from_hf_state_dict(model.state_dict(), cfg), cfg
+    raise ConvertError(f"unsupported model_type {kind!r} (llama | mixtral)")
+
+
+def load_hf_llama(
+    model_or_path: Any, dtype: Any = jnp.bfloat16
+) -> tuple[dict, ModelConfig]:
+    """Dense-only variant of :func:`load_hf` for callers that want the
+    type guarantee — rejects MoE checkpoints BEFORE converting any
+    weights (a real Mixtral state dict is tens of GB)."""
+    model = _resolve_model(model_or_path)
+    if getattr(model.config, "model_type", "llama") != "llama":
+        raise ConvertError("checkpoint is not a dense Llama — use load_hf")
+    return load_hf(model, dtype=dtype)
