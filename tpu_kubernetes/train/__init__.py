@@ -19,3 +19,10 @@ from tpu_kubernetes.train.trainer import (  # noqa: F401
     synthetic_batches,
     train_step,
 )
+from tpu_kubernetes.train.lora import (  # noqa: F401
+    LoraConfig,
+    init_lora_state,
+    lora_train_step,
+    make_sharded_lora_step,
+    merge_lora,
+)

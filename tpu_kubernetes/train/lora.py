@@ -1,0 +1,208 @@
+"""LoRA finetuning: low-rank adapters over frozen base weights.
+
+Finetuning a pretrained model (e.g. one imported via
+models/convert_hf.load_hf) rarely needs — or can afford — full-parameter
+training: AdamW keeps two f32 moments per parameter, ~6× the bf16 weight
+bytes. LoRA (Hu et al., 2021) trains only low-rank deltas
+``W_eff = W + (α/r)·A@B`` with A (in, r), B (r, out) — optimizer state
+shrinks by the rank ratio and the base stays frozen byte-for-byte.
+
+TPU-first shape of the implementation:
+
+* **Merge-form forward.** Each step materializes ``W + scale·A@B`` for
+  the adapted leaves and calls the UNMODIFIED model forward — no
+  per-matmul hook points, both model families (dense and MoE expert
+  stacks) work unchanged, and XLA sees one fused outer-product-add per
+  stacked weight (cheap next to the matmuls that consume it). Gradients
+  flow to A/B through the merge; the base is frozen simply by
+  differentiating only the adapter argument.
+* **Adapters inherit sharding from their base leaf**: A shards like the
+  weight's input axis, B like its output axis, the rank axis replicated —
+  derived mechanically from models.logical_axes, so tensor/fsdp/expert
+  sharded finetuning works with the existing mesh rules.
+* B initializes to zero (standard): step 0 is exactly the base model.
+* ``merge_lora`` exports plain params for serving/quantization.
+
+The reference provisioner has no training plane (SURVEY §0); this
+extends the in-tree stack's finetuning surface.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tpu_kubernetes.models import ModelConfig, logical_axes, loss_fn
+from tpu_kubernetes.parallel import (
+    batch_sharding,
+    logical_to_spec,
+    param_shardings,
+)
+
+
+@dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    # layer-stack leaves to adapt (attention projections by default — the
+    # standard LoRA target set; add the mlp trio for higher capacity)
+    targets: tuple[str, ...] = ("wq", "wk", "wv", "wo")
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def init_lora(
+    rng: jax.Array, params: dict, cfg: ModelConfig, lc: LoraConfig
+) -> dict:
+    """→ {leaf_name: {"a": (..., in, r), "b": (..., r, out)}} for every
+    target leaf present in params["layers"]. Leading (layer / expert)
+    stack dims are preserved so the scan/expert structure is unchanged.
+    A ~ N(0, 1/in), B = 0 — the merged model starts exactly at the base."""
+    adapters: dict[str, dict] = {}
+    for name in lc.targets:
+        if name not in params["layers"]:
+            raise ValueError(f"LoRA target {name!r} not in params.layers")
+        w = params["layers"][name]
+        if w.ndim < 3:
+            # every adaptable leaf is a stacked matrix (layer[, expert],
+            # in, out); a 2-D leaf like a norm gain would silently couple
+            # the layer stack as if it were a feature dimension
+            raise ValueError(
+                f"LoRA target {name!r} has shape {w.shape} — only stacked "
+                "matmul weights can carry adapters"
+            )
+        *lead, d_in, d_out = w.shape
+        rng, k = jax.random.split(rng)
+        adapters[name] = {
+            "a": (jax.random.normal(k, (*lead, d_in, lc.rank), w.dtype)
+                  / jnp.sqrt(jnp.asarray(d_in, jnp.float32)).astype(w.dtype)),
+            "b": jnp.zeros((*lead, lc.rank, d_out), w.dtype),
+        }
+    return adapters
+
+
+def merge_lora(
+    params: dict, adapters: dict, lc: LoraConfig
+) -> dict:
+    """Base params + scale·A@B on every adapted leaf → plain params
+    pytree (same structure as the base — feed to forward/generate/
+    quantize_for_decode/serving unchanged)."""
+    layers = dict(params["layers"])
+    for name, ab in adapters.items():
+        w = layers[name]
+        delta = jnp.matmul(ab["a"], ab["b"]) * jnp.asarray(
+            lc.scale, w.dtype
+        )
+        layers[name] = w + delta
+    return {**params, "layers": layers}
+
+
+def lora_loss_fn(
+    adapters: dict, params: dict, tokens: jax.Array, cfg: ModelConfig,
+    lc: LoraConfig,
+) -> jax.Array:
+    """loss_fn over the merged model, differentiable in the ADAPTERS only
+    (differentiate argument 0; the base rides along as a constant)."""
+    return loss_fn(merge_lora(params, adapters, lc), tokens, cfg)
+
+
+def make_lora_optimizer(lc: LoraConfig, learning_rate: float = 1e-4):
+    """AdamW over adapter leaves only — no weight decay on B (it starts
+    at zero; decaying it fights the update direction for nothing)."""
+    del lc
+    return optax.adamw(learning_rate, weight_decay=0.0)
+
+
+def init_lora_state(
+    rng: jax.Array, params: dict, cfg: ModelConfig, lc: LoraConfig,
+    learning_rate: float = 1e-4,
+) -> dict:
+    adapters = init_lora(rng, params, cfg, lc)
+    opt_state = make_lora_optimizer(lc, learning_rate).init(adapters)
+    return {
+        "adapters": adapters,
+        "opt_state": opt_state,
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def lora_train_step(
+    state: dict, params: dict, batch: jax.Array, cfg: ModelConfig,
+    lc: LoraConfig, learning_rate: float = 1e-4,
+) -> tuple[dict, jax.Array]:
+    """One finetuning step: grads w.r.t. adapters only, base untouched."""
+    loss_value, grads = jax.value_and_grad(lora_loss_fn)(
+        state["adapters"], params, batch, cfg, lc
+    )
+    updates, new_opt = make_lora_optimizer(lc, learning_rate).update(
+        grads, state["opt_state"], state["adapters"]
+    )
+    new_adapters = optax.apply_updates(state["adapters"], updates)
+    return (
+        {
+            "adapters": new_adapters,
+            "opt_state": new_opt,
+            "step": state["step"] + 1,
+        },
+        loss_value,
+    )
+
+
+def lora_shardings(cfg: ModelConfig, lc: LoraConfig, mesh: Mesh):
+    """Adapter shardings derived from each base leaf's logical axes:
+    A keeps the leading + input axes and replicates the rank dim; B keeps
+    the leading axes, replicates rank, and keeps the output axis."""
+    layer_axes = logical_axes(cfg)["layers"]
+    out: dict[str, dict] = {}
+    for name in lc.targets:
+        axes = layer_axes[name]          # e.g. ("layer", "embed", "heads")
+        lead, ax_in, ax_out = axes[:-2], axes[-2], axes[-1]
+        spec_a = logical_to_spec((*lead, ax_in, None), mesh=mesh)
+        spec_b = logical_to_spec((*lead, None, ax_out), mesh=mesh)
+        out[name] = {
+            "a": NamedSharding(mesh, spec_a),
+            "b": NamedSharding(mesh, spec_b),
+        }
+    return out
+
+
+def make_sharded_lora_step(
+    cfg: ModelConfig, lc: LoraConfig, mesh: Mesh, state: dict, params: dict,
+    learning_rate: float = 1e-4,
+) -> tuple[Callable, Any, Any, Any]:
+    """→ (jitted step(state, params, batch), state shardings, param
+    shardings, batch sharding) — the finetuning analog of
+    make_sharded_train_step. The base params stay sharded by the model's
+    own logical axes and are never donated (they are reused every step)."""
+    from tpu_kubernetes.train.trainer import opt_state_shardings
+
+    a_sh = lora_shardings(cfg, lc, mesh)
+    replicated = NamedSharding(mesh, PartitionSpec())
+    state_sh = {
+        "adapters": a_sh,
+        # adamw moments mirror the adapter pytree per-leaf
+        "opt_state": opt_state_shardings(
+            state["opt_state"], state["adapters"], a_sh, replicated
+        ),
+        "step": replicated,
+    }
+    p_sh = param_shardings(logical_axes(cfg), mesh)
+    b_sh = batch_sharding(mesh)
+    step = jax.jit(
+        functools.partial(
+            lora_train_step, cfg=cfg, lc=lc, learning_rate=learning_rate
+        ),
+        in_shardings=(state_sh, p_sh, b_sh),
+        out_shardings=(state_sh, replicated),
+        donate_argnums=(0,),
+    )
+    return step, state_sh, p_sh, b_sh
+

@@ -121,30 +121,36 @@ def state_shardings(
     if p_shardings is None:
         p_shardings = param_shardings(logical_axes(cfg), mesh)
     replicated = NamedSharding(mesh, PartitionSpec())
-
-    # match opt_state structure by mapping over it with params-shaped
-    # subtrees replaced by p_shardings
-    def map_opt(tree):
-        params_treedef = jax.tree.structure(state["params"])
-        def rec(node):
-            if jax.tree.structure(node) == params_treedef:
-                return p_shardings
-            if hasattr(node, "_fields"):  # NamedTuple (optax states) — must
-                return type(node)(*(rec(x) for x in node))  # precede tuple
-            if isinstance(node, tuple):
-                return tuple(rec(x) for x in node)
-            if isinstance(node, list):
-                return [rec(x) for x in node]
-            if isinstance(node, dict):
-                return {k: rec(v) for k, v in node.items()}
-            return replicated
-        return rec(tree)
-
     return {
         "params": p_shardings,
-        "opt_state": map_opt(state["opt_state"]),
+        "opt_state": opt_state_shardings(
+            state["opt_state"], state["params"], p_shardings, replicated
+        ),
         "step": replicated,
     }
+
+
+def opt_state_shardings(opt_state, params_like, target_shardings, replicated):
+    """Optimizer-state pytree → shardings: subtrees structured like
+    ``params_like`` get ``target_shardings``, everything else replicates.
+    Shared by the full-training and LoRA sharded steps (optimizer moments
+    always mirror whatever pytree is being optimized)."""
+    template_treedef = jax.tree.structure(params_like)
+
+    def rec(node):
+        if jax.tree.structure(node) == template_treedef:
+            return target_shardings
+        if hasattr(node, "_fields"):  # NamedTuple (optax states) — must
+            return type(node)(*(rec(x) for x in node))  # precede tuple
+        if isinstance(node, tuple):
+            return tuple(rec(x) for x in node)
+        if isinstance(node, list):
+            return [rec(x) for x in node]
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        return replicated
+
+    return rec(opt_state)
 
 
 def make_sharded_train_step(
