@@ -11,6 +11,13 @@ import os
 # sitecustomize re-forces it at jax import), but the suite must be hermetic
 # and runs shardings on a virtual 8-device mesh
 os.environ["JAX_PLATFORMS"] = "cpu"
+# drop the tunneled-TPU triggers entirely: with them set, the image's
+# sitecustomize registers the remote platform at INTERPRETER start — in this
+# process and in every subprocess tests spawn — and that registration can
+# block for minutes when the remote pool is down (observed), even though the
+# suite never uses it (same pair test_job_entrypoint strips)
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.pop("TPU_ACCELERATOR_TYPE", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -169,3 +169,32 @@ def test_job_manifest_targets_what_the_cluster_example_provisions(cli_home):
     # the job's mesh is the one the cluster example validated at render time
     env = {e["name"]: e.get("value") for e in pod["containers"][0]["env"]}
     assert env["JOB_MESH"] == "data=1,fsdp=8,tensor=2"
+
+
+def test_serving_job_manifest_consistent():
+    """The serving example must point at the serve entrypoint with a mesh
+    matching its chip request, and every SERVE_* knob it sets must be one
+    the entrypoint documents (env-contract drift check)."""
+    import yaml
+
+    with open("examples/jobs/serve-llama-v5e8.yaml") as f:
+        job = yaml.safe_load(f)
+    pod = job["spec"]["template"]["spec"]
+    container = pod["containers"][0]
+    assert "tpu_kubernetes.serve.job" in container["args"][-1]
+
+    env = {e["name"]: e.get("value") for e in container["env"]}
+    chips = int(container["resources"]["limits"]["google.com/tpu"])
+    from tpu_kubernetes.topology import parse_mesh_shape
+
+    import math
+
+    mesh = parse_mesh_shape(env["SERVE_MESH"])
+    assert math.prod(mesh.values()) == chips
+
+    import tpu_kubernetes.serve.job as serve_job
+
+    doc = serve_job.__doc__
+    for name in env:
+        if name.startswith("SERVE_"):
+            assert name in doc, f"{name} not documented in serve/job.py"

@@ -1,0 +1,80 @@
+"""Serving entrypoint (serve/job.py): prompts file → completions through
+the sharded ragged pipeline, env contract errors, quantized mode, and the
+CLI subprocess surface (what the JobSet pod actually runs)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpu_kubernetes.serve import run_serving
+
+
+@pytest.fixture()
+def prompts_file(tmp_path):
+    p = tmp_path / "prompts.txt"
+    p.write_text("hello tpu\nrings of ici\nshort\n")
+    return p
+
+
+def _env(prompts, out, **extra):
+    env = {
+        "SERVE_PROMPTS": str(prompts),
+        "SERVE_OUT": str(out),
+        "SERVE_MODEL": "llama-test",
+        "SERVE_MAX_NEW": "6",
+        "SERVE_BATCH": "2",
+    }
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def test_serves_prompts_in_order(tmp_path, prompts_file):
+    out = tmp_path / "out.txt"
+    completions = run_serving(_env(prompts_file, out))
+    assert len(completions) == 3
+    written = out.read_text().splitlines()
+    # the file escapes \n/\r so line i always pairs with prompt i
+    assert written == [
+        c.replace("\\", "\\\\").replace("\n", "\\n").replace("\r", "\\r")
+        for c in completions
+    ]
+    # greedy + fixed seed: rerun is deterministic
+    again = run_serving(_env(prompts_file, tmp_path / "out2.txt"))
+    assert again == completions
+
+
+def test_int8_mode_runs(tmp_path, prompts_file):
+    out = tmp_path / "out.txt"
+    completions = run_serving(_env(prompts_file, out, SERVE_QUANT="int8"))
+    assert len(completions) == 3
+
+
+def test_missing_prompts_rejected(tmp_path):
+    with pytest.raises(SystemExit, match="SERVE_PROMPTS"):
+        run_serving({"SERVE_MODEL": "llama-test"})
+
+
+def test_overlong_prompt_rejected(tmp_path):
+    p = tmp_path / "p.txt"
+    p.write_text("x" * 500 + "\n")  # llama-test max_seq = 128
+    with pytest.raises(SystemExit, match="max_seq"):
+        run_serving(_env(p, tmp_path / "o.txt"))
+
+
+def test_cli_subprocess(tmp_path, prompts_file):
+    out = tmp_path / "out.txt"
+    env = _env(prompts_file, out)
+    env["JAX_PLATFORMS"] = "cpu"
+    import os
+
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_kubernetes.serve.job"],
+        capture_output=True, text=True,
+        env={**os.environ, **env},
+        cwd=Path(__file__).resolve().parent.parent,
+    )
+    assert r.returncode == 0, r.stderr
+    assert len(out.read_text().splitlines()) == 3
+    assert "tok/s" in r.stderr

@@ -1,0 +1,5 @@
+"""tpu_kubernetes.serve — the batch-inference entrypoint of the in-tree
+stack (``python -m tpu_kubernetes.serve.job``), the serving analog of
+tpu_kubernetes.train.job."""
+
+from tpu_kubernetes.serve.job import main, run_serving  # noqa: F401

@@ -1,0 +1,205 @@
+"""Batch-inference job entrypoint: prompts file in, completions out.
+
+The serving analog of train/job.py — what a JobSet pod runs on a
+provisioned slice (examples/jobs/serve-llama-v5e8.yaml). One compiled program
+serves the whole file: prompts are tokenized, right-padded to one static
+width (ragged semantics — each row generates from its own last real
+token, models/decode.py), batched to a fixed row count, and generated
+through the tensor-parallel sharded path (parallel/serving.py). The
+whole pipeline is env-driven like the trainer:
+
+  SERVE_MODEL          preset name (default llama-test) — random init,
+                       smoke/bring-up mode
+  SERVE_HF_CHECKPOINT  LOCAL transformers checkpoint dir (overrides
+                       SERVE_MODEL; models/convert_hf.load_hf — llama or
+                       mixtral; never downloads)
+  SERVE_TOKENIZER      'byte' (default) or 'hf:<local path>'
+                       (train/corpus.py's resolver)
+  SERVE_PROMPTS        path to a UTF-8 text file, one prompt per line
+  SERVE_OUT            output path ('-' = stdout, default); one
+                       completion per line, same order (newlines inside a
+                       completion are escaped as \\n so line i always
+                       pairs with prompt i)
+  SERVE_BATCH          rows per compiled call (default 8; the last batch
+                       pads with repeats, extras dropped)
+  SERVE_MAX_NEW        tokens to generate per prompt (default 64)
+  SERVE_MESH           e.g. 'tensor=4' or 'data=2,tensor=2'
+                       (default: tensor over all local devices)
+  SERVE_QUANT          'int8' → weight-only quantized export
+                       (models/quant.py); empty = model dtype
+  SERVE_TEMPERATURE / SERVE_TOP_K / SERVE_TOP_P / SERVE_SEED
+  SERVE_EOS_ID         stop rows at this token (emitted tokens after it
+                       are dropped from the text)
+
+The reference provisioner has no inference plane (SURVEY §0); this
+completes the in-tree stack's serving story end to end (provision →
+import weights → quantize → shard → serve).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from pathlib import Path
+
+
+def log(*args) -> None:
+    print("[serve]", *args, file=sys.stderr, flush=True)
+
+
+def _detokenizer(spec: str):
+    """Inverse of train/corpus.py's tokenizers: ids → text. The byte
+    decoder never silently drops ids — run_serving refuses up front when
+    the model can emit ids the tokenizer cannot render."""
+    if spec == "byte":
+        return lambda ids: bytes(ids).decode("utf-8", errors="replace")
+    if spec.startswith("hf:"):
+        from transformers import AutoTokenizer
+
+        tok = AutoTokenizer.from_pretrained(
+            spec[3:], local_files_only=True
+        )
+        return lambda ids: tok.decode(list(ids), skip_special_tokens=True)
+    raise ValueError(f"unknown tokenizer {spec!r}")
+
+
+def run_serving(env: dict | None = None) -> list[str]:
+    """The whole pipeline; ``env`` defaults to os.environ (injectable for
+    tests). Returns the completions (also written to SERVE_OUT)."""
+    env = dict(os.environ if env is None else env)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_kubernetes.models import CONFIGS, init_params
+    from tpu_kubernetes.models.quant import quantize_for_decode
+    from tpu_kubernetes.parallel import create_mesh, make_sharded_generate
+    from tpu_kubernetes.train.corpus import resolve_tokenizer
+
+    prompts_path = env.get("SERVE_PROMPTS", "")
+    if not prompts_path:
+        raise SystemExit("SERVE_PROMPTS must point at a prompts file")
+    prompts = Path(prompts_path).read_text(encoding="utf-8").splitlines()
+    if not prompts:
+        raise SystemExit(f"{prompts_path} holds no prompts")
+
+    tok_spec = env.get("SERVE_TOKENIZER", "byte")
+    encode, vocab = resolve_tokenizer(tok_spec)
+    decode_text = _detokenizer(tok_spec)
+
+    hf_path = env.get("SERVE_HF_CHECKPOINT", "")
+    t0 = time.perf_counter()
+    if hf_path:
+        from tpu_kubernetes.models import load_hf
+
+        params, cfg = load_hf(hf_path)
+        log(f"loaded HF checkpoint {hf_path} in {time.perf_counter()-t0:.1f}s")
+    else:
+        cfg = CONFIGS[env.get("SERVE_MODEL", "llama-test")]
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        log(f"random-init {env.get('SERVE_MODEL', 'llama-test')} "
+            "(smoke mode — set SERVE_HF_CHECKPOINT for real weights)")
+    if vocab > cfg.vocab_size:
+        raise SystemExit(
+            f"tokenizer vocab {vocab} exceeds model vocab {cfg.vocab_size}"
+        )
+    if vocab < cfg.vocab_size:
+        # the model can sample ids the tokenizer cannot render — garbled
+        # output with no diagnostic; demand a matching tokenizer
+        raise SystemExit(
+            f"tokenizer vocab {vocab} cannot render model vocab "
+            f"{cfg.vocab_size} — pass the model's own tokenizer "
+            "(SERVE_TOKENIZER=hf:<path>)"
+        )
+
+    if env.get("SERVE_QUANT", "") == "int8":
+        params = quantize_for_decode(params, cfg)
+        log("int8 weight-only export")
+
+    mesh_spec = env.get("SERVE_MESH", "")
+    if mesh_spec:
+        from tpu_kubernetes.topology import parse_mesh_shape
+
+        shape = parse_mesh_shape(mesh_spec)
+    else:
+        shape = {"tensor": len(jax.devices())}
+    mesh = create_mesh(shape)
+    log(f"mesh={dict(mesh.shape)}")
+
+    max_new = int(env.get("SERVE_MAX_NEW", "64"))
+    batch_rows = int(env.get("SERVE_BATCH", "8"))
+    eos_env = env.get("SERVE_EOS_ID", "")
+    eos_id = int(eos_env) if eos_env else None
+    pad_id = 0
+
+    token_rows = [encode(p) for p in prompts]
+    if any(not r for r in token_rows):
+        raise SystemExit("empty prompt line — every line must tokenize "
+                         "to at least one token")
+    width = max(len(r) for r in token_rows)
+    if width + max_new > cfg.max_seq:
+        raise SystemExit(
+            f"longest prompt ({width}) + SERVE_MAX_NEW ({max_new}) "
+            f"exceeds the model's max_seq {cfg.max_seq}"
+        )
+
+    fn, p_sh, b_sh = make_sharded_generate(
+        cfg, mesh, params, max_new_tokens=max_new,
+        temperature=float(env.get("SERVE_TEMPERATURE", "0")),
+        top_k=int(env.get("SERVE_TOP_K", "0")),
+        top_p=float(env.get("SERVE_TOP_P", "0")),
+        eos_id=eos_id, pad_id=pad_id,
+    )
+    params = jax.device_put(params, p_sh)
+    rng = jax.random.PRNGKey(int(env.get("SERVE_SEED", "0")))
+
+    completions: list[str] = []
+    n_tokens = 0
+    t0 = time.perf_counter()
+    for start in range(0, len(token_rows), batch_rows):
+        rows = token_rows[start:start + batch_rows]
+        n_real = len(rows)
+        rows = rows + [rows[-1]] * (batch_rows - n_real)  # pad the batch
+        lengths = jnp.asarray([len(r) for r in rows], jnp.int32)
+        padded = np.zeros((batch_rows, width), np.int32)
+        for i, r in enumerate(rows):
+            padded[i, :len(r)] = r
+        rng, call_rng = jax.random.split(rng)
+        out = fn(
+            params, jax.device_put(jnp.asarray(padded), b_sh),
+            rng=call_rng, prompt_lengths=lengths,
+        )
+        for row in np.asarray(out)[:n_real]:
+            ids = row.tolist()
+            if eos_id is not None and eos_id in ids:
+                ids = ids[:ids.index(eos_id)]
+            n_tokens += len(ids)
+            completions.append(decode_text(ids))
+    dt = time.perf_counter() - t0
+    log(f"{len(prompts)} prompts, {n_tokens} tokens "
+        f"in {dt:.1f}s ({n_tokens / dt:.0f} tok/s)")
+
+    out_path = env.get("SERVE_OUT", "-")
+    # keep the line↔prompt pairing exact no matter what the model emits
+    escaped = [
+        c.replace("\\", "\\\\").replace("\n", "\\n").replace("\r", "\\r")
+        for c in completions
+    ]
+    text = "\n".join(escaped) + "\n"
+    if out_path == "-":
+        sys.stdout.write(text)
+    else:
+        Path(out_path).write_text(text, encoding="utf-8")
+        log(f"wrote {out_path}")
+    return completions
+
+
+def main() -> int:
+    run_serving()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
