@@ -25,6 +25,7 @@ from tpu_kubernetes.models.llama import ModelConfig  # noqa: F401
 from tpu_kubernetes.models.llama import param_count  # noqa: F401
 from tpu_kubernetes.models.moe import MoEConfig, expert_capacity  # noqa: F401
 from tpu_kubernetes.models.convert_hf import (  # noqa: F401
+    export_hf_llama,
     load_hf,
     load_hf_llama,
 )

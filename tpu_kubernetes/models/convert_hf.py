@@ -250,3 +250,97 @@ def load_hf_llama(
     if getattr(model.config, "model_type", "llama") != "llama":
         raise ConvertError("checkpoint is not a dense Llama — use load_hf")
     return load_hf(model, dtype=dtype)
+
+
+def export_hf_llama(
+    params: dict, cfg: ModelConfig, path: Any = None, torch_dtype: Any = None
+):
+    """The inverse interop: a DENSE param pytree → a transformers Llama
+    model (saved to ``path`` when given) — so anything this framework
+    trains or finetunes (e.g. a merged LoRA, train/lora.merge_lora) can
+    ride the rest of the ecosystem. Exact inverse of the import mapping;
+    round-trip parity is pinned in tests.
+
+    ``torch_dtype`` defaults to the pytree's own weight dtype (a
+    bf16-trained model exports bf16 — half the bytes of f32 and the
+    ecosystem convention); pass ``torch.float32`` to up-cast."""
+    if isinstance(cfg, MoEConfig):
+        raise ConvertError("export supports the dense family only")
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    if torch_dtype is None:
+        torch_dtype = (
+            torch.bfloat16
+            if jnp.dtype(params["embed"].dtype) == jnp.bfloat16
+            else torch.float32
+        )
+    hf_config = LlamaConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.d_model,
+        intermediate_size=cfg.d_ff,
+        num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_heads,
+        num_key_value_heads=cfg.n_kv_heads,
+        max_position_embeddings=cfg.max_seq,
+        rope_theta=cfg.rope_theta,
+        rms_norm_eps=cfg.norm_eps,
+        attention_bias=False,
+        tie_word_embeddings=False,
+        torch_dtype=torch_dtype,
+    )
+
+    # one bulk device→host transfer per stacked weight, then numpy slicing
+    # (not one transfer per layer); f32 is the lossless interchange for
+    # both bf16 and f32 sources, cast to torch_dtype at tensor creation
+    def host(arr) -> np.ndarray:
+        return np.asarray(arr, np.float32)
+
+    def t(arr: np.ndarray) -> Any:
+        return torch.tensor(arr).to(torch_dtype)
+
+    layers = {k: host(v) for k, v in params["layers"].items()}
+    sd = {
+        "model.embed_tokens.weight": t(host(params["embed"])),
+        "model.norm.weight": t(host(params["final_norm"])),
+        "lm_head.weight": t(host(params["lm_head"]).T.copy()),
+    }
+    names = {
+        "wq": "self_attn.q_proj", "wk": "self_attn.k_proj",
+        "wv": "self_attn.v_proj", "wo": "self_attn.o_proj",
+        "w_gate": "mlp.gate_proj", "w_up": "mlp.up_proj",
+        "w_down": "mlp.down_proj",
+    }
+    for i in range(cfg.n_layers):
+        sd[f"model.layers.{i}.input_layernorm.weight"] = t(
+            layers["attn_norm"][i]
+        )
+        sd[f"model.layers.{i}.post_attention_layernorm.weight"] = t(
+            layers["mlp_norm"][i]
+        )
+        for ours, theirs in names.items():
+            sd[f"model.layers.{i}.{theirs}.weight"] = t(
+                layers[ours][i].T.copy()
+            )
+
+    # build on the meta device so torch never allocates (or random-inits)
+    # a throwaway copy of the weights; assign=True adopts our tensors
+    try:
+        with torch.device("meta"):
+            model = LlamaForCausalLM(hf_config)
+        model.load_state_dict(sd, assign=True)
+        # non-persistent buffers (the rotary inv_freq) are not in the
+        # state dict and stayed on meta — rebuild that module for real
+        model.model.rotary_emb = type(model.model.rotary_emb)(
+            config=hf_config
+        )
+        if any(b.is_meta for b in model.buffers()):
+            raise RuntimeError("meta buffers remain after export")
+    except (AttributeError, NotImplementedError, RuntimeError, TypeError):
+        # older torch/transformers layouts: pay the full init once
+        model = LlamaForCausalLM(hf_config)
+        model.load_state_dict(sd)
+    model.eval()
+    if path is not None:
+        model.save_pretrained(str(path))
+    return model
