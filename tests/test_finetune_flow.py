@@ -1,0 +1,98 @@
+"""The full model lifecycle in one hermetic test: import a pretrained
+(HF) checkpoint → LoRA-finetune on byte-level shards → merge → int8
+export → serve prompts through the sharded entrypoint → export back to a
+transformers checkpoint. Every arrow is an API this framework ships; if
+any contract drifts, this is the test that notices."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from transformers import LlamaConfig, LlamaForCausalLM  # noqa: E402
+
+from tpu_kubernetes.models import (  # noqa: E402
+    export_hf_llama,
+    generate,
+    load_hf,
+    quantize_for_decode,
+)
+from tpu_kubernetes.serve import run_serving  # noqa: E402
+from tpu_kubernetes.train.corpus import build_shards  # noqa: E402
+from tpu_kubernetes.train.data import TokenDataset  # noqa: E402
+from tpu_kubernetes.train.lora import (  # noqa: E402
+    LoraConfig,
+    init_lora_state,
+    lora_train_step,
+    merge_lora,
+)
+
+
+def test_pretrained_to_served_lifecycle(tmp_path):
+    # 1. a "pretrained" model arrives as a transformers checkpoint
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False,
+    )).eval()
+    ckpt = tmp_path / "pretrained"
+    hf.save_pretrained(str(ckpt))
+    params, cfg = load_hf(str(ckpt), dtype=jnp.float32)
+
+    # 2. corpus → token shards → training windows
+    text = tmp_path / "corpus.txt"
+    text.write_text("the rings of ici carry the collectives\n" * 40)
+    shards = tmp_path / "shards"
+    build_shards([text], shards)
+    ds = TokenDataset(shards, seq=32, vocab_size=cfg.vocab_size)
+    batch = jnp.stack([jnp.asarray(ds.sequence(i)) for i in range(4)])
+
+    # 3. LoRA-finetune the frozen base on that corpus
+    lc = LoraConfig(rank=4)
+    state = init_lora_state(jax.random.PRNGKey(1), params, cfg, lc,
+                            learning_rate=5e-3)
+    step = jax.jit(
+        lambda s, p, b: lora_train_step(s, p, b, cfg, lc,
+                                        learning_rate=5e-3)
+    )
+    state, first = step(state, params, batch)
+    for _ in range(6):
+        state, loss = step(state, params, batch)
+    assert float(loss) < float(first)  # it learned the corpus
+
+    # 4. merge and quantize for serving
+    merged = merge_lora(params, state["adapters"], lc)
+    qmerged = quantize_for_decode(merged, cfg)
+    prompt = jnp.asarray(np.frombuffer(b"the rings", np.uint8)[None, :]
+                         .astype(np.int32))
+    out = generate(qmerged, prompt, cfg, max_new_tokens=8)
+    assert out.shape == (1, 8)
+
+    # 5. the serving entrypoint serves the merged weights end to end
+    #    (via its HF-checkpoint path — which the export below creates)
+    served_ckpt = tmp_path / "finetuned"
+    export_hf_llama(merged, cfg, served_ckpt, torch_dtype=torch.float32)
+    prompts = tmp_path / "prompts.txt"
+    prompts.write_text("the rings\nof ici\n")
+    completions = run_serving({
+        "SERVE_HF_CHECKPOINT": str(served_ckpt),
+        "SERVE_PROMPTS": str(prompts),
+        "SERVE_OUT": str(tmp_path / "completions.txt"),
+        "SERVE_MAX_NEW": "6",
+        "SERVE_BATCH": "2",
+    })
+    assert len(completions) == 2
+
+    # 6. and the exported checkpoint is a real transformers model
+    reloaded = LlamaForCausalLM.from_pretrained(str(served_ckpt))
+    tokens = np.random.default_rng(0).integers(0, 256, (1, 9))
+    with torch.no_grad():
+        theirs = reloaded(torch.tensor(tokens)).logits.numpy()
+    from tpu_kubernetes.models import forward
+
+    ours = np.asarray(forward(merged, jnp.asarray(tokens), cfg))
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=2e-2)
