@@ -1,0 +1,173 @@
+"""LoRA finetuning job entrypoint: pretrained checkpoint in, merged
+(and optionally HF-exported) weights out.
+
+The deployable form of docs/guide/finetuning.md — what a JobSet pod runs
+on a provisioned slice, sibling of train/job.py (pretraining) and
+serve/job.py (inference). Env contract:
+
+  FT_HF_CHECKPOINT  LOCAL transformers checkpoint to adapt
+                    (models/convert_hf.load_hf; llama or mixtral)
+  FT_MODEL          preset name instead (random init — smoke/bring-up
+                    mode; default llama-test)
+  FT_DATA_PATH      token shards (train/corpus.py format); synthetic
+                    tokens when unset (smoke mode)
+  FT_STEPS          optimizer steps (default 100)
+  FT_BATCH / FT_SEQ batch rows / sequence length (defaults: device count
+                    scaled / model max_seq)
+  FT_RANK / FT_ALPHA / FT_TARGETS   LoRA shape (defaults 8 / 16 /
+                    wq,wk,wv,wo — comma-separated layer leaves)
+  FT_LR             adapter learning rate (default 1e-4)
+  FT_MESH           e.g. 'fsdp=4,tensor=2' (default: auto for the
+                    device count)
+  FT_OUT            directory for the MERGED weights as an orbax
+                    checkpoint (required — a finetune that saves nothing
+                    is a smoke test, use FT_STEPS=0 for that)
+  FT_EXPORT_HF      optional directory: additionally export the merged
+                    model as a transformers checkpoint (dense only)
+
+The base stays frozen; only adapter moments exist (train/lora.py), so
+this fits where full training would not.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from pathlib import Path
+
+
+def log(*args) -> None:
+    print("[finetune]", *args, file=sys.stderr, flush=True)
+
+
+def run_finetune(env: dict | None = None) -> None:
+    env = dict(os.environ if env is None else env)
+
+    import jax
+
+    # honor an explicit JAX_PLATFORMS even where a sitecustomize re-forces
+    # a tunneled TPU platform at import (same stance as train/job.py —
+    # local CPU smoke runs must be possible)
+    if env.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", env["JAX_PLATFORMS"])
+
+    from tpu_kubernetes.parallel import (
+        enable_persistent_compile_cache,
+        initialize,
+    )
+
+    cache = enable_persistent_compile_cache()
+    if cache:
+        log(f"compile cache: {cache}")
+    denv = initialize()  # multi-host slice: jax.distributed bootstrap
+    log(f"process {denv.process_id}/{denv.num_processes}")
+
+    from tpu_kubernetes.models import CONFIGS, MoEConfig, init_params
+    from tpu_kubernetes.parallel import create_mesh, mesh_shape_for_devices
+    from tpu_kubernetes.train import synthetic_batches
+    from tpu_kubernetes.train.checkpoint import save
+    from tpu_kubernetes.train.lora import (
+        LoraConfig,
+        init_lora_state,
+        make_sharded_lora_step,
+        merge_lora,
+    )
+
+    out_dir = env.get("FT_OUT", "")
+    steps = int(env.get("FT_STEPS", "100"))
+    if not out_dir and steps > 0:
+        raise SystemExit("FT_OUT must name the merged-weights directory "
+                         "(FT_STEPS=0 for a no-output smoke run)")
+
+    t_start = time.time()
+    hf_path = env.get("FT_HF_CHECKPOINT", "")
+    if hf_path:
+        from tpu_kubernetes.models import load_hf
+
+        params, cfg = load_hf(hf_path)
+        log(f"base: HF checkpoint {hf_path}")
+    else:
+        cfg = CONFIGS[env.get("FT_MODEL", "llama-test")]
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        log(f"base: random-init {env.get('FT_MODEL', 'llama-test')} "
+            "(smoke mode)")
+    if env.get("FT_EXPORT_HF", "") and isinstance(cfg, MoEConfig):
+        # fail in seconds, not after the whole finetune (job.py stance)
+        raise SystemExit("FT_EXPORT_HF supports the dense family only")
+
+    n = len(jax.devices())
+    batch = int(env.get("FT_BATCH", str(max(4, n))))
+    seq = int(env.get("FT_SEQ", str(cfg.max_seq)))
+    lc = LoraConfig(
+        rank=int(env.get("FT_RANK", "8")),
+        alpha=float(env.get("FT_ALPHA", "16")),
+        targets=tuple(
+            t for t in env.get("FT_TARGETS", "wq,wk,wv,wo").split(",") if t
+        ),
+    )
+    lr = float(env.get("FT_LR", "1e-4"))
+
+    mesh_spec = env.get("FT_MESH", "")
+    if mesh_spec:
+        from tpu_kubernetes.topology import parse_mesh_shape
+
+        mesh = create_mesh(parse_mesh_shape(mesh_spec))
+    else:
+        mesh = create_mesh(mesh_shape_for_devices(n))
+    log(f"devices={n} mesh={dict(mesh.shape)} rank={lc.rank} "
+        f"targets={','.join(lc.targets)} batch={batch} seq={seq}")
+
+    state = init_lora_state(jax.random.PRNGKey(1), params, cfg, lc,
+                            learning_rate=lr)
+    step_fn, s_sh, p_sh, b_sh = make_sharded_lora_step(
+        cfg, lc, mesh, state, params, learning_rate=lr
+    )
+    state = jax.device_put(state, s_sh)
+    params = jax.device_put(params, p_sh)
+
+    data_path = env.get("FT_DATA_PATH", "")
+    if data_path:
+        from tpu_kubernetes.train import input_pipeline
+
+        batches = input_pipeline(data_path, batch, seq, cfg.vocab_size, b_sh)
+        log(f"data: {data_path}")
+    else:
+        from tpu_kubernetes.train import prefetch
+
+        batches = prefetch(
+            jax.device_put(b, b_sh)
+            for b in synthetic_batches(cfg.vocab_size, batch, seq)
+        )
+        log("data: synthetic")
+
+    first = True
+    for i in range(steps):
+        state, loss = step_fn(state, params, next(batches))
+        if first:
+            jax.block_until_ready(loss)
+            log(f"FIRST FINETUNE STEP at +{time.time() - t_start:.1f}s "
+                f"loss={float(loss):.4f}")
+            first = False
+        if (i + 1) % 25 == 0 or i + 1 == steps:
+            log(f"step {i + 1}/{steps} loss={float(loss):.4f}")
+
+    merged = merge_lora(params, state["adapters"], lc)
+    if out_dir:
+        save(out_dir, {"params": merged}, step=steps)
+        log(f"merged weights → {out_dir}")
+    export_dir = env.get("FT_EXPORT_HF", "")
+    if export_dir:
+        from tpu_kubernetes.models import export_hf_llama
+
+        export_hf_llama(merged, cfg, Path(export_dir))
+        log(f"HF export → {export_dir}")
+
+
+def main() -> int:
+    run_finetune()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
