@@ -36,7 +36,6 @@ import jax
 import jax.numpy as jnp
 
 from tpu_kubernetes.models.llama import ModelConfig
-from tpu_kubernetes.models.moe import MoEConfig
 
 # leaves under params["layers"] that are plain (L, in, out) matmul weights
 _LAYER_MATMUL_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
