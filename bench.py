@@ -578,9 +578,10 @@ def _merge_dense(result: dict) -> None:
         "vs_baseline": round(result["mfu"] / 0.40, 4),
         "chips": 1,
         "isolation": "subprocess-per-section",
-        "note": ("r03 dense regression (388.4->399.0ms) attributed to "
-                 "MoE+decode co-resident in the dense process; sections "
-                 "now run in isolated subprocesses"),
+        "note": ("sections run in isolated subprocesses (r03's 2.7% dense "
+                 "regression was co-resident-section interference) and "
+                 "timed regions sync by transfer with the RTT subtracted "
+                 "(remote block_until_ready can return early)"),
         **{k: v for k, v in result.items() if k != "mfu"},
     })
 
