@@ -40,12 +40,12 @@ real consumer.
 
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from tpu_kubernetes.models.llama import (
     ModelConfig,
@@ -54,6 +54,11 @@ from tpu_kubernetes.models.llama import (
     remat_policy_kwargs,
 )
 from tpu_kubernetes.ops import next_token_nll, rms_norm, rope_frequencies
+from tpu_kubernetes.ops.grouped_matmul import (
+    DEFAULT_BLOCK_M,
+    _int_zeros,  # symbolic-zero integer cotangent — shared with the kernel VJPs
+    grouped_matmul,
+)
 
 
 @dataclass(frozen=True)
@@ -65,6 +70,11 @@ class MoEConfig(ModelConfig):
     router_aux_coef: float = 0.01
     # "gather": indexed dispatch/combine (row gathers, custom-VJP backward)
     # "einsum": GShard dense one-hot dispatch (oracle; O(b·s·E·C·d) flops)
+    # "grouped": DROPLESS — sort token rows by expert and run the Pallas
+    #   grouped matmul (ops/grouped_matmul.py); no capacity, no drops
+    #   (capacity_factor is ignored). Single-shard experts: the opaque
+    #   kernel hides the expert dim from the pjit partitioner, so keep
+    #   "gather"/"einsum" for expert-parallel meshes.
     dispatch_mode: str = "gather"
     # MoE-aware remat: save the routing plan + bucketed activations so the
     # backward never re-runs the routing machinery (llama.py:
@@ -226,6 +236,25 @@ def _route(gates: jax.Array, k: int, capacity: int):
     return dispatch, combine, first_mask
 
 
+def _topk_plan(gates: jax.Array, k: int):
+    """Shared selection + combine-weight computation for the indexed
+    dispatch paths (gather and grouped — one place, so the modes can
+    never diverge in the gate weighting).
+
+    Returns (expert_idx (k, b, s) int32, masks [k × (b, s, E) one-hot],
+    weight (k, b, s) f32). ``weight`` is renormalized over ALL selected
+    experts (Mixtral semantics, matching :func:`_route`: on capacity
+    paths, dropped selections still count in the denominator); it is the
+    router's gradient path."""
+    idxs, masks = _topk_selection(gates, k)
+    expert_idx = jnp.stack(idxs)                      # (k, b, s)
+    gate_r = jnp.stack([
+        jnp.sum(gates * m, axis=-1) for m in masks
+    ])                                                # (k, b, s) f32
+    weight = gate_r / jnp.maximum(jnp.sum(gate_r, axis=0), 1e-9)
+    return expert_idx, masks, weight
+
+
 def _route_plan(gates: jax.Array, k: int, capacity: int):
     """Indexed form of :func:`_route`: the same k argmax rounds and causal
     slot cumsum, but returned as per-round index/weight arrays instead of
@@ -234,31 +263,20 @@ def _route_plan(gates: jax.Array, k: int, capacity: int):
     Returns (dst, keep, weight, first):
       dst    (k, b, s) int32 — flat slot e·C + pos each round targets
       keep   (k, b, s) bool  — slot within capacity (token not dropped)
-      weight (k, b, s) f32   — combine weight, gate renormalized over the
-                               token's k selected experts (differentiable —
-                               this is the router's gradient path)
+      weight (k, b, s) f32   — combine weight (see :func:`_topk_plan`)
       first  (b, s, E) f32   — first-choice one-hot for the balance loss
     """
-    b, s, E = gates.shape
-    idxs, masks = _topk_selection(gates, k)
+    expert_idx, masks, weight = _topk_plan(gates, k)
     first = masks[0]
 
     total = sum(masks)
     pos_all = jnp.cumsum(total, axis=1) - total       # (b, s, E) exclusive
 
-    expert_idx = jnp.stack(idxs)                      # (k, b, s)
     pos = jnp.stack([
         jnp.sum(pos_all * m, axis=-1).astype(jnp.int32) for m in masks
     ])
-    gate_r = jnp.stack([
-        jnp.sum(gates * m, axis=-1) for m in masks
-    ])                                                # (k, b, s) f32
-
     keep = pos < capacity
     dst = expert_idx * capacity + pos
-    # renormalize over ALL selected experts (Mixtral semantics, matching
-    # _route: dropped selections still count in the denominator)
-    weight = gate_r / jnp.maximum(jnp.sum(gate_r, axis=0), 1e-9)
     return dst, keep, weight, first
 
 
@@ -293,9 +311,6 @@ def _take_rows(table, idx):
     return jnp.take_along_axis(table, idx[..., None], axis=1)
 
 
-def _int_zeros(a):
-    """Symbolic-zero cotangent for an integer/bool primal."""
-    return np.zeros(a.shape, jax.dtypes.float0)
 
 
 @jax.custom_vjp
@@ -376,6 +391,55 @@ def _combine_rows_bwd(res, dout):
 _combine_rows.defvjp(_combine_rows_fwd, _combine_rows_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _dispatch_sorted(y2, token_of, inv, k):
+    """Token rows → expert-sorted rows for the grouped path:
+    (b·s, d) → (b·s·k, d), row i = y2[token_of[i]]. The default VJP would
+    scatter-add b·s·k feature rows back into b·s; the custom backward is
+    the complementary gather instead — each token collects its k sorted
+    cotangent rows through ``inv`` and sums them (the same no-row-scatter
+    guarantee the gather path's _dispatch_rows makes)."""
+    return jnp.take(y2, token_of, axis=0)
+
+
+def _dispatch_sorted_fwd(y2, token_of, inv, k):
+    return jnp.take(y2, token_of, axis=0), (token_of, inv)
+
+
+def _dispatch_sorted_bwd(k, res, dout):
+    token_of, inv = res
+    d = dout.shape[-1]
+    dy2 = jnp.sum(jnp.take(dout, inv, axis=0).reshape(-1, k, d), axis=1)
+    return dy2.astype(dout.dtype), _int_zeros(token_of), _int_zeros(inv)
+
+
+_dispatch_sorted.defvjp(_dispatch_sorted_fwd, _dispatch_sorted_bwd)
+
+
+@jax.custom_vjp
+def _unsort_rows(rows, inv, perm):
+    """Expert-sorted rows → (token, choice) order: row i = rows[inv[i]].
+    ``inv``/``perm`` are inverse permutations of each other, so both
+    directions — and both cotangents — are pure gathers."""
+    return jnp.take(rows, inv, axis=0)
+
+
+def _unsort_rows_fwd(rows, inv, perm):
+    return jnp.take(rows, inv, axis=0), (inv, perm)
+
+
+def _unsort_rows_bwd(res, dout):
+    inv, perm = res
+    return (
+        jnp.take(dout, perm, axis=0),
+        _int_zeros(inv),
+        _int_zeros(perm),
+    )
+
+
+_unsort_rows.defvjp(_unsort_rows_fwd, _unsort_rows_bwd)
+
+
 def _expert_mlp(cfg: MoEConfig, xe, layer):
     """The experts' SwiGLU over bucketed tokens xe (b, E, C, d)."""
     gated = jax.nn.silu(
@@ -429,6 +493,54 @@ def moe_sublayer(cfg: MoEConfig, x, layer):
         )
         out = _combine_rows(
             out_e.reshape(b, cfg.n_experts * C, d), weight, dst, keep, src, valid
+        )
+    elif cfg.dispatch_mode == "grouped":
+        from jax.ad_checkpoint import checkpoint_name
+
+        k, E = cfg.experts_per_token, cfg.n_experts
+        expert_idx, masks, weight = _topk_plan(gates, k)
+        first = masks[0]
+
+        # sort the (token, choice) rows by expert → contiguous groups.
+        # Row t·k + r is token t's round-r choice, so token order within an
+        # expert is preserved (stable sort) and the inverse map is a gather.
+        m_rows = b * s * k
+        m_pad = -(-m_rows // DEFAULT_BLOCK_M) * DEFAULT_BLOCK_M
+        e_flat = expert_idx.transpose(1, 2, 0).reshape(m_rows)
+        perm = jnp.argsort(e_flat, stable=True)
+        sizes = jnp.bincount(e_flat, length=E).astype(jnp.int32)
+        # alignment pad rows ride in the last group; their lhs rows are
+        # zero, so their outputs are zero and nothing gathers them back
+        sizes = sizes.at[E - 1].add(m_pad - m_rows)
+        token_of = perm // k                                  # (M,)
+        inv = (
+            jnp.zeros((m_rows,), jnp.int32)
+            .at[perm]
+            .set(jnp.arange(m_rows, dtype=jnp.int32), unique_indices=True)
+        )
+        perm = checkpoint_name(perm, "moe_plan")
+        sizes = checkpoint_name(sizes, "moe_plan")
+        token_of = checkpoint_name(token_of, "moe_plan")
+        inv = checkpoint_name(inv, "moe_plan")
+        weight = checkpoint_name(weight, "moe_plan")
+
+        y2 = y.reshape(b * s, d)
+        lhs = jnp.pad(
+            _dispatch_sorted(y2, token_of, inv, k), ((0, m_pad - m_rows), (0, 0))
+        )
+        lhs = checkpoint_name(lhs, "moe_dispatch")
+        gmm = functools.partial(grouped_matmul, use_pallas=cfg.use_pallas)
+        gated = jax.nn.silu(gmm(lhs, layer["w_gate"], sizes)) * gmm(
+            lhs, layer["w_up"], sizes
+        )
+        rows_out = checkpoint_name(
+            gmm(gated, layer["w_down"], sizes), "moe_expert_out"
+        )
+        rows_tok = _unsort_rows(rows_out[:m_rows], inv, perm)
+        w_tok = weight.transpose(1, 2, 0).reshape(b, s, k)
+        out = jnp.sum(
+            rows_tok.reshape(b, s, k, d) * w_tok[..., None].astype(rows_tok.dtype),
+            axis=2,
         )
     else:
         raise ValueError(f"unknown dispatch_mode {cfg.dispatch_mode!r}")

@@ -5,6 +5,10 @@ from tpu_kubernetes.ops.flash_attention import (  # noqa: F401
     attention_reference,
     flash_attention,
 )
+from tpu_kubernetes.ops.grouped_matmul import (  # noqa: F401
+    grouped_matmul,
+    grouped_matmul_reference,
+)
 from tpu_kubernetes.ops.losses import next_token_nll  # noqa: F401
 from tpu_kubernetes.ops.norms import (  # noqa: F401
     apply_rope,

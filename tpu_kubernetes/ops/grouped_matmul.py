@@ -1,0 +1,355 @@
+"""Grouped (ragged) matmul as a Pallas TPU kernel — the dropless-MoE
+expert GEMM (fwd + bwd, custom VJP).
+
+``grouped_matmul(lhs, rhs, group_sizes)`` multiplies contiguous row groups
+of ``lhs`` (M, K) against per-group weights ``rhs`` (E, K, N): rows
+``[off_e, off_{e+1})`` (offsets = cumsative group sizes) go through
+``rhs[e]``. This is the MegaBlocks-shaped primitive behind
+``dispatch_mode="grouped"`` in models/moe.py: sort tokens by expert, run
+ONE kernel whose grid walks (expert, row-block) pairs — no expert-capacity
+padding, no dropped tokens, and the group boundary handling lives in the
+kernel instead of a (b, s, E, C) dispatch tensor.
+
+TPU design:
+
+* **Static shapes.** Group sizes are data-dependent VALUES but every array
+  shape is static: the tile enumeration runs as traced integer ops whose
+  results feed the kernel through scalar prefetch (SMEM), and the worst
+  case — every group boundary splitting a row block — bounds the grid at
+  ``M/block_m + E - 1`` tiles.
+* **Grid (N-blocks, tiles), tiles innermost**, so the tiles covering one
+  output row-block are adjacent grid steps: partial products accumulate in
+  an f32 VMEM scratch and are written once, when the last tile of the
+  block retires. Consecutive tiles of one group also keep the (K, block_n)
+  weight block resident in VMEM (no refetch within a group).
+* Row→group membership is enforced by masking lhs rows against the group's
+  offset range before the dot, so a block spanning a boundary contributes
+  each row to exactly one group. All matmuls accumulate in float32 on the
+  MXU (``preferred_element_type``).
+* Backward reuses the machinery: dlhs = grouped_matmul(dout, rhsᵀ) (same
+  kernel, swapped operands); drhs accumulates lhs-blockᵀ @ dout-block per
+  group in a second kernel with the same tile enumeration.
+
+A plain-XLA reference (``grouped_matmul_reference``) is the correctness
+oracle in tests (the kernel runs in interpret mode on CPU) and the
+fallback on non-TPU backends.
+
+The reference provisioner has no ML code; this op belongs to the in-tree
+training stack's MoE family (SURVEY.md §2.7).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from tpu_kubernetes.ops.flash_attention import _fit_block, _on_tpu
+
+try:  # the grid spec + scratch spaces here genuinely need pltpu (unlike
+    # flash_attention, whose specs degrade to plain BlockSpec); without it
+    # every path falls back to the XLA reference
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+DEFAULT_BLOCK_M = 128
+DEFAULT_BLOCK_N = 512
+
+
+def _int_zeros(a):
+    """Symbolic-zero cotangent for an integer primal."""
+    return np.zeros(a.shape, jax.dtypes.float0)
+
+
+# --------------------------------------------------------------------------
+# reference (XLA) implementation — oracle + non-TPU fallback
+# --------------------------------------------------------------------------
+
+def grouped_matmul_reference(
+    lhs: jax.Array, rhs: jax.Array, group_sizes: jax.Array
+) -> jax.Array:
+    """Plain-XLA grouped matmul: E full matmuls with row masks, summed.
+    O(E·M·K·N) flops — fine at test shapes and as the CPU fallback; the
+    Pallas kernel is the TPU path."""
+    m = lhs.shape[0]
+    off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(group_sizes, dtype=jnp.int32)]
+    )
+    rows = jnp.arange(m, dtype=jnp.int32)
+    gid = jnp.searchsorted(off[1:], rows, side="right").astype(jnp.int32)
+    valid = rows < off[-1]
+
+    def step(acc, xs):
+        w, e = xs
+        sel = ((gid == e) & valid)[:, None]
+        prod = jnp.dot(lhs, w, preferred_element_type=jnp.float32)
+        return acc + jnp.where(sel, prod, 0.0), None
+
+    acc = jnp.zeros((m, rhs.shape[-1]), jnp.float32)
+    acc, _ = jax.lax.scan(
+        step, acc, (rhs, jnp.arange(rhs.shape[0], dtype=jnp.int32))
+    )
+    return acc.astype(lhs.dtype)
+
+
+# --------------------------------------------------------------------------
+# tile enumeration (traced; feeds the kernels via scalar prefetch)
+# --------------------------------------------------------------------------
+
+# rows of the (7, T) tile-metadata array
+_ROW, _GRP, _FIRST_ROW, _LAST_ROW, _FIRST_GRP, _LAST_GRP, _ACTIVE = range(7)
+
+
+def _tile_metadata(group_sizes: jax.Array, n_rows: int, bm: int):
+    """Enumerate (row-block, group) intersection tiles, sorted by (group,
+    row). Static tile count T = n_rows/bm + E - 1 (worst case: every group
+    boundary splits a block); unused tail tiles are flagged inactive and
+    mapped onto the final block so they never trigger a buffer flush of an
+    unwritten block. Returns (tiles (7, T) int32, offsets (E+1,) int32)."""
+    e = group_sizes.shape[0]
+    mb = n_rows // bm
+    t_static = mb + e - 1
+    off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(group_sizes, dtype=jnp.int32)]
+    )
+    nonempty = group_sizes > 0
+    fb = off[:-1] // bm                                   # first block of g
+    lb = jnp.where(nonempty, (off[1:] - 1) // bm, 0)      # last block of g
+    ntiles = jnp.where(nonempty, lb - fb + 1, 0)
+    ts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(ntiles, dtype=jnp.int32)]
+    )
+
+    tt = jnp.arange(t_static, dtype=jnp.int32)
+    active = tt < ts[-1]
+    g = jnp.clip(
+        jnp.searchsorted(ts[1:], tt, side="right").astype(jnp.int32), 0, e - 1
+    )
+    row = fb[g] + (tt - ts[g])
+    row = jnp.where(active, row, mb - 1)
+    g = jnp.where(active, g, e - 1)
+
+    prev_row = jnp.concatenate([jnp.full((1,), -1, jnp.int32), row[:-1]])
+    prev_g = jnp.concatenate([jnp.full((1,), -1, jnp.int32), g[:-1]])
+    nxt_active = jnp.concatenate([active[1:], jnp.zeros((1,), bool)])
+    nxt_row = jnp.concatenate([row[1:], jnp.full((1,), -1, jnp.int32)])
+    nxt_g = jnp.concatenate([g[1:], jnp.full((1,), -1, jnp.int32)])
+
+    first_row = active & (row != prev_row)
+    last_row = active & ((row != nxt_row) | ~nxt_active)
+    first_grp = active & (g != prev_g)
+    last_grp = active & ((g != nxt_g) | ~nxt_active)
+
+    tiles = jnp.stack([
+        row, g,
+        first_row.astype(jnp.int32), last_row.astype(jnp.int32),
+        first_grp.astype(jnp.int32), last_grp.astype(jnp.int32),
+        active.astype(jnp.int32),
+    ])
+    return tiles, off
+
+
+def _row_mask(tiles_ref, off_ref, t, bm):
+    """(bm, 1) bool — rows of tile t's block that belong to tile t's group."""
+    g = tiles_ref[_GRP, t]
+    row0 = tiles_ref[_ROW, t] * bm
+    rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+    return (
+        (rows >= off_ref[g]) & (rows < off_ref[g + 1])
+        & (tiles_ref[_ACTIVE, t] == 1)
+    )
+
+
+# --------------------------------------------------------------------------
+# forward kernel (also computes dlhs with swapped operands)
+# --------------------------------------------------------------------------
+
+def _gmm_kernel(tiles_ref, off_ref, lhs_ref, rhs_ref, out_ref, acc_ref, *,
+                bm: int):
+    t = pl.program_id(1)
+    mask = _row_mask(tiles_ref, off_ref, t, bm)
+    lhs = jnp.where(mask, lhs_ref[...], jnp.zeros((), lhs_ref.dtype))
+    prod = jnp.dot(lhs, rhs_ref[0], preferred_element_type=jnp.float32)
+
+    @pl.when(tiles_ref[_FIRST_ROW, t] == 1)
+    def _init():
+        acc_ref[...] = prod
+
+    @pl.when(tiles_ref[_FIRST_ROW, t] == 0)
+    def _accum():
+        acc_ref[...] += prod
+
+    @pl.when(tiles_ref[_LAST_ROW, t] == 1)
+    def _emit():
+        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
+def _gmm_call(lhs, rhs, group_sizes, block_m, block_n, interpret):
+    m, k = lhs.shape
+    e, _, n = rhs.shape
+    tiles, off = _tile_metadata(group_sizes, m, block_m)
+    grid = (n // block_n, tiles.shape[1])
+    return pl.pallas_call(
+        functools.partial(_gmm_kernel, bm=block_m),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((block_m, k), lambda j, t, tiles, off: (tiles[_ROW, t], 0)),
+                pl.BlockSpec((1, k, block_n), lambda j, t, tiles, off: (tiles[_GRP, t], 0, j)),
+            ],
+            out_specs=pl.BlockSpec(
+                (block_m, block_n), lambda j, t, tiles, off: (tiles[_ROW, t], j)
+            ),
+            scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((m, n), lhs.dtype),
+        interpret=interpret,
+    )(tiles, off, lhs, rhs)
+
+
+# --------------------------------------------------------------------------
+# backward: per-group weight gradient
+# --------------------------------------------------------------------------
+
+def _gmm_drhs_kernel(tiles_ref, off_ref, lhs_ref, dout_ref, drhs_ref,
+                     acc_ref, *, bm: int):
+    t = pl.program_id(1)
+    mask = _row_mask(tiles_ref, off_ref, t, bm)
+    lhs = jnp.where(mask, lhs_ref[...], jnp.zeros((), lhs_ref.dtype))
+    # (bm, K)ᵀ @ (bm, bn) → (K, bn), contracting the row dim
+    prod = jax.lax.dot_general(
+        lhs, dout_ref[...], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(tiles_ref[_FIRST_GRP, t] == 1)
+    def _init():
+        acc_ref[...] = prod
+
+    @pl.when(tiles_ref[_FIRST_GRP, t] == 0)
+    def _accum():
+        acc_ref[...] += prod
+
+    @pl.when(tiles_ref[_LAST_GRP, t] == 1)
+    def _emit():
+        drhs_ref[0] = acc_ref[...].astype(drhs_ref.dtype)
+
+
+def _gmm_drhs_call(lhs, dout, group_sizes, n_groups, block_m, block_n,
+                   interpret, out_dtype):
+    m, k = lhs.shape
+    n = dout.shape[1]
+    tiles, off = _tile_metadata(group_sizes, m, block_m)
+    grid = (n // block_n, tiles.shape[1])
+    drhs = pl.pallas_call(
+        functools.partial(_gmm_drhs_kernel, bm=block_m),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((block_m, k), lambda j, t, tiles, off: (tiles[_ROW, t], 0)),
+                pl.BlockSpec((block_m, block_n), lambda j, t, tiles, off: (tiles[_ROW, t], j)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, k, block_n), lambda j, t, tiles, off: (tiles[_GRP, t], 0, j)
+            ),
+            scratch_shapes=[pltpu.VMEM((k, block_n), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_groups, k, n), out_dtype),
+        interpret=interpret,
+    )(tiles, off, lhs, dout)
+    # empty groups own no tiles: their blocks are never written (the tail
+    # flush can leave uninitialized memory there) — mask them to zero
+    return jnp.where(group_sizes[:, None, None] > 0, drhs, 0).astype(out_dtype)
+
+
+# --------------------------------------------------------------------------
+# custom VJP + public API
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _gmm(lhs, rhs, group_sizes, block_m, block_n, interpret):
+    return _gmm_call(lhs, rhs, group_sizes, block_m, block_n, interpret)
+
+
+def _gmm_fwd(lhs, rhs, group_sizes, block_m, block_n, interpret):
+    out = _gmm_call(lhs, rhs, group_sizes, block_m, block_n, interpret)
+    return out, (lhs, rhs, group_sizes)
+
+
+def _gmm_bwd(block_m, block_n, interpret, res, dout):
+    lhs, rhs, group_sizes = res
+    k = lhs.shape[1]
+    # dlhs rows of group e: dout rows @ rhs[e]ᵀ — the same grouped matmul
+    dlhs = _gmm_call(
+        dout, rhs.swapaxes(1, 2), group_sizes,
+        block_m, _fit_block(block_n, k), interpret,
+    )
+    drhs = _gmm_drhs_call(
+        lhs, dout, group_sizes, rhs.shape[0], block_m, block_n,
+        interpret, rhs.dtype,
+    )
+    return dlhs.astype(lhs.dtype), drhs, _int_zeros(group_sizes)
+
+
+_gmm.defvjp(_gmm_fwd, _gmm_bwd)
+
+
+def grouped_matmul(
+    lhs: jax.Array,
+    rhs: jax.Array,
+    group_sizes: jax.Array,
+    *,
+    block_m: int = DEFAULT_BLOCK_M,
+    block_n: int = DEFAULT_BLOCK_N,
+    use_pallas: bool | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Per-group matmul over contiguous row groups.
+
+    lhs (M, K) with rows sorted so group e occupies rows
+    [Σ_{i<e} group_sizes[i], Σ_{i≤e} group_sizes[i]); rhs (E, K, N);
+    group_sizes (E,) int32 → out (M, N) in lhs.dtype where group e's rows
+    are ``lhs[rows_e] @ rhs[e]``.
+
+    Requirements for the kernel path: ``sum(group_sizes) == M`` (pad the
+    final group to cover alignment rows — their outputs are garbage-free
+    zeros only if the padded lhs rows are zero), M divisible by block_m,
+    N by block_n, and K a multiple of 128 (lane tiling). Rows past
+    ``sum(group_sizes)`` are only supported by the reference path.
+
+    ``use_pallas=None`` auto-selects the kernel on TPU and the XLA
+    reference elsewhere; ``interpret=True`` forces the kernel through the
+    Pallas interpreter (CPU-testable). Differentiable in lhs and rhs.
+    """
+    m, k = lhs.shape
+    e, k2, n = rhs.shape
+    if k != k2 or group_sizes.shape != (e,):
+        raise ValueError(
+            f"shape mismatch: lhs {lhs.shape}, rhs {rhs.shape}, "
+            f"group_sizes {group_sizes.shape}"
+        )
+    if use_pallas is None:
+        use_pallas = _on_tpu()
+    if pltpu is None or not (use_pallas or interpret):
+        return grouped_matmul_reference(lhs, rhs, group_sizes)
+
+    block_m = _fit_block(block_m, m)
+    block_n = _fit_block(block_n, n)
+    if m % block_m or n % block_n:
+        raise ValueError(
+            f"(M, N) = ({m}, {n}) must be divisible by blocks "
+            f"({block_m}, {block_n})"
+        )
+    if k % 128:
+        # lane tiling, and the guarantee that the backward's dlhs block
+        # fit (_fit_block(block_n, K)) lands on a divisor of K
+        raise ValueError(f"K = {k} must be a multiple of 128")
+    return _gmm(
+        lhs, rhs, group_sizes.astype(jnp.int32), block_m, block_n, interpret
+    )
