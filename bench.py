@@ -292,7 +292,11 @@ def measure_train(model_name: str, batch: int, seq: int, steps: int,
             cfg, remat=remat_env not in ("0", "false", "no", "off"),
         )
 
-    tc = TrainConfig(warmup_steps=10)
+    # BENCH_OPT=adafactor measures the factored-second-moment optimizer
+    # (the optimizer-traffic experiment from the MoE perf investigation)
+    tc = TrainConfig(
+        warmup_steps=10, optimizer=os.environ.get("BENCH_OPT", "adamw")
+    )
     t0 = time.perf_counter()
     with jax.default_device(device):
         state = init_state(jax.random.PRNGKey(0), cfg, tc)

@@ -3,7 +3,7 @@ expert GEMM (fwd + bwd, custom VJP).
 
 ``grouped_matmul(lhs, rhs, group_sizes)`` multiplies contiguous row groups
 of ``lhs`` (M, K) against per-group weights ``rhs`` (E, K, N): rows
-``[off_e, off_{e+1})`` (offsets = cumsative group sizes) go through
+``[off_e, off_{e+1})`` (offsets = cumulative group sizes) go through
 ``rhs[e]``. This is the MegaBlocks-shaped primitive behind
 ``dispatch_mode="grouped"`` in models/moe.py: sort tokens by expert, run
 ONE kernel whose grid walks (expert, row-block) pairs — no expert-capacity
@@ -17,11 +17,12 @@ TPU design:
   results feed the kernel through scalar prefetch (SMEM), and the worst
   case — every group boundary splitting a row block — bounds the grid at
   ``M/block_m + E - 1`` tiles.
-* **Grid (N-blocks, tiles), tiles innermost**, so the tiles covering one
-  output row-block are adjacent grid steps: partial products accumulate in
-  an f32 VMEM scratch and are written once, when the last tile of the
-  block retires. Consecutive tiles of one group also keep the (K, block_n)
-  weight block resident in VMEM (no refetch within a group).
+* **Grid (N-blocks, tiles, K-blocks)**, so the (tile, K-block) steps
+  covering one output row-block are adjacent: partial products accumulate
+  in an f32 VMEM scratch and are written once, when the last tile's last
+  K-block retires. K is tiled (``block_k``) so VMEM residency never
+  scales with the full contraction dim — mixtral-8x7b's d_ff=14336
+  stays a few hundred KB per block, not a 14 MB operand.
 * Row→group membership is enforced by masking lhs rows against the group's
   offset range before the dot, so a block spanning a boundary contributes
   each row to exactly one group. All matmuls accumulate in float32 on the
@@ -58,6 +59,18 @@ except Exception:  # pragma: no cover
 
 DEFAULT_BLOCK_M = 128
 DEFAULT_BLOCK_N = 512
+DEFAULT_BLOCK_K = 512
+
+
+def _fit_block_div(block: int, dim: int) -> int:
+    """Largest multiple-of-128 divisor of ``dim`` that is ≤ ``block``.
+    Requires dim % 128 == 0 (the public entry enforces it), so 128 always
+    qualifies — unlike halving, this can never hand back a non-divisor
+    that would silently truncate a grid."""
+    for c in range(min(block, dim) // 128, 0, -1):
+        if dim % (128 * c) == 0:
+            return 128 * c
+    return 128
 
 
 def _int_zeros(a):
@@ -171,39 +184,52 @@ def _row_mask(tiles_ref, off_ref, t, bm):
 def _gmm_kernel(tiles_ref, off_ref, lhs_ref, rhs_ref, out_ref, acc_ref, *,
                 bm: int):
     t = pl.program_id(1)
+    kb = pl.program_id(2)
     mask = _row_mask(tiles_ref, off_ref, t, bm)
     lhs = jnp.where(mask, lhs_ref[...], jnp.zeros((), lhs_ref.dtype))
     prod = jnp.dot(lhs, rhs_ref[0], preferred_element_type=jnp.float32)
 
-    @pl.when(tiles_ref[_FIRST_ROW, t] == 1)
+    first = (tiles_ref[_FIRST_ROW, t] == 1) & (kb == 0)
+    last = (tiles_ref[_LAST_ROW, t] == 1) & (kb == pl.num_programs(2) - 1)
+
+    @pl.when(first)
     def _init():
         acc_ref[...] = prod
 
-    @pl.when(tiles_ref[_FIRST_ROW, t] == 0)
+    @pl.when(~first)
     def _accum():
         acc_ref[...] += prod
 
-    @pl.when(tiles_ref[_LAST_ROW, t] == 1)
+    @pl.when(last)
     def _emit():
         out_ref[...] = acc_ref[...].astype(out_ref.dtype)
 
 
-def _gmm_call(lhs, rhs, group_sizes, block_m, block_n, interpret):
+def _gmm_call(lhs, rhs, group_sizes, block_m, block_n, block_k, interpret):
     m, k = lhs.shape
     e, _, n = rhs.shape
     tiles, off = _tile_metadata(group_sizes, m, block_m)
-    grid = (n // block_n, tiles.shape[1])
+    # K innermost: one output row-block's partial products — across its
+    # tiles AND K-blocks — are adjacent grid steps for the scratch
+    grid = (n // block_n, tiles.shape[1], k // block_k)
     return pl.pallas_call(
         functools.partial(_gmm_kernel, bm=block_m),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((block_m, k), lambda j, t, tiles, off: (tiles[_ROW, t], 0)),
-                pl.BlockSpec((1, k, block_n), lambda j, t, tiles, off: (tiles[_GRP, t], 0, j)),
+                pl.BlockSpec(
+                    (block_m, block_k),
+                    lambda j, t, kb, tiles, off: (tiles[_ROW, t], kb),
+                ),
+                pl.BlockSpec(
+                    (1, block_k, block_n),
+                    lambda j, t, kb, tiles, off: (tiles[_GRP, t], kb, j),
+                ),
             ],
             out_specs=pl.BlockSpec(
-                (block_m, block_n), lambda j, t, tiles, off: (tiles[_ROW, t], j)
+                (block_m, block_n),
+                lambda j, t, kb, tiles, off: (tiles[_ROW, t], j),
             ),
             scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
         ),
@@ -218,10 +244,10 @@ def _gmm_call(lhs, rhs, group_sizes, block_m, block_n, interpret):
 
 def _gmm_drhs_kernel(tiles_ref, off_ref, lhs_ref, dout_ref, drhs_ref,
                      acc_ref, *, bm: int):
-    t = pl.program_id(1)
+    t = pl.program_id(2)
     mask = _row_mask(tiles_ref, off_ref, t, bm)
     lhs = jnp.where(mask, lhs_ref[...], jnp.zeros((), lhs_ref.dtype))
-    # (bm, K)ᵀ @ (bm, bn) → (K, bn), contracting the row dim
+    # (bm, bk)ᵀ @ (bm, bn) → (bk, bn), contracting the row dim
     prod = jax.lax.dot_general(
         lhs, dout_ref[...], (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -241,24 +267,33 @@ def _gmm_drhs_kernel(tiles_ref, off_ref, lhs_ref, dout_ref, drhs_ref,
 
 
 def _gmm_drhs_call(lhs, dout, group_sizes, n_groups, block_m, block_n,
-                   interpret, out_dtype):
+                   block_k, interpret, out_dtype):
     m, k = lhs.shape
     n = dout.shape[1]
     tiles, off = _tile_metadata(group_sizes, m, block_m)
-    grid = (n // block_n, tiles.shape[1])
+    # tiles innermost: one group's row tiles are adjacent per (j, kb), so
+    # the (bk, bn) scratch accumulates a full group before emitting
+    grid = (n // block_n, k // block_k, tiles.shape[1])
     drhs = pl.pallas_call(
         functools.partial(_gmm_drhs_kernel, bm=block_m),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((block_m, k), lambda j, t, tiles, off: (tiles[_ROW, t], 0)),
-                pl.BlockSpec((block_m, block_n), lambda j, t, tiles, off: (tiles[_ROW, t], j)),
+                pl.BlockSpec(
+                    (block_m, block_k),
+                    lambda j, kb, t, tiles, off: (tiles[_ROW, t], kb),
+                ),
+                pl.BlockSpec(
+                    (block_m, block_n),
+                    lambda j, kb, t, tiles, off: (tiles[_ROW, t], j),
+                ),
             ],
             out_specs=pl.BlockSpec(
-                (1, k, block_n), lambda j, t, tiles, off: (tiles[_GRP, t], 0, j)
+                (1, block_k, block_n),
+                lambda j, kb, t, tiles, off: (tiles[_GRP, t], kb, j),
             ),
-            scratch_shapes=[pltpu.VMEM((k, block_n), jnp.float32)],
+            scratch_shapes=[pltpu.VMEM((block_k, block_n), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((n_groups, k, n), out_dtype),
         interpret=interpret,
@@ -272,26 +307,34 @@ def _gmm_drhs_call(lhs, dout, group_sizes, n_groups, block_m, block_n,
 # custom VJP + public API
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _gmm(lhs, rhs, group_sizes, block_m, block_n, interpret):
-    return _gmm_call(lhs, rhs, group_sizes, block_m, block_n, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _gmm(lhs, rhs, group_sizes, block_m, block_n, block_k, interpret):
+    return _gmm_call(
+        lhs, rhs, group_sizes, block_m, block_n, block_k, interpret
+    )
 
 
-def _gmm_fwd(lhs, rhs, group_sizes, block_m, block_n, interpret):
-    out = _gmm_call(lhs, rhs, group_sizes, block_m, block_n, interpret)
+def _gmm_fwd(lhs, rhs, group_sizes, block_m, block_n, block_k, interpret):
+    out = _gmm_call(
+        lhs, rhs, group_sizes, block_m, block_n, block_k, interpret
+    )
     return out, (lhs, rhs, group_sizes)
 
 
-def _gmm_bwd(block_m, block_n, interpret, res, dout):
+def _gmm_bwd(block_m, block_n, block_k, interpret, res, dout):
     lhs, rhs, group_sizes = res
     k = lhs.shape[1]
+    n = rhs.shape[2]
     # dlhs rows of group e: dout rows @ rhs[e]ᵀ — the same grouped matmul
+    # with (N', K') = (K, N); blocks re-fit as DIVISORS of the swapped dims
+    # (a non-divisor block would silently truncate the grid)
     dlhs = _gmm_call(
         dout, rhs.swapaxes(1, 2), group_sizes,
-        block_m, _fit_block(block_n, k), interpret,
+        block_m, _fit_block_div(block_n, k), _fit_block_div(block_k, n),
+        interpret,
     )
     drhs = _gmm_drhs_call(
-        lhs, dout, group_sizes, rhs.shape[0], block_m, block_n,
+        lhs, dout, group_sizes, rhs.shape[0], block_m, block_n, block_k,
         interpret, rhs.dtype,
     )
     return dlhs.astype(lhs.dtype), drhs, _int_zeros(group_sizes)
@@ -307,6 +350,7 @@ def grouped_matmul(
     *,
     block_m: int = DEFAULT_BLOCK_M,
     block_n: int = DEFAULT_BLOCK_N,
+    block_k: int = DEFAULT_BLOCK_K,
     use_pallas: bool | None = None,
     interpret: bool = False,
 ) -> jax.Array:
@@ -320,8 +364,9 @@ def grouped_matmul(
     Requirements for the kernel path: ``sum(group_sizes) == M`` (pad the
     final group to cover alignment rows — their outputs are garbage-free
     zeros only if the padded lhs rows are zero), M divisible by block_m,
-    N by block_n, and K a multiple of 128 (lane tiling). Rows past
-    ``sum(group_sizes)`` are only supported by the reference path.
+    N by block_n, and K and N multiples of 128 (lane tiling; the backward
+    swaps them). Rows past ``sum(group_sizes)`` are only supported by the
+    reference path.
 
     ``use_pallas=None`` auto-selects the kernel on TPU and the XLA
     reference elsewhere; ``interpret=True`` forces the kernel through the
@@ -347,9 +392,15 @@ def grouped_matmul(
             f"({block_m}, {block_n})"
         )
     if k % 128:
-        # lane tiling, and the guarantee that the backward's dlhs block
-        # fit (_fit_block(block_n, K)) lands on a divisor of K
+        # lane tiling, and the guarantee that _fit_block_div always finds
+        # a divisor for the K grid here and the swapped dims in backward
         raise ValueError(f"K = {k} must be a multiple of 128")
+    if n % 128:
+        # backward runs the forward kernel with (N', K') = (K, N), so N
+        # must satisfy K's constraint too
+        raise ValueError(f"N = {n} must be a multiple of 128")
+    block_k = _fit_block_div(block_k, k)
     return _gmm(
-        lhs, rhs, group_sizes.astype(jnp.int32), block_m, block_n, interpret
+        lhs, rhs, group_sizes.astype(jnp.int32),
+        block_m, block_n, block_k, interpret,
     )

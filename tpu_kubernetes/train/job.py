@@ -14,7 +14,9 @@ cross-slice axes, e.g. "data=2" — JOB_MESH then describes the intra-slice
 ICI axes), JOB_DATA_PATH (token shards; synthetic data when unset),
 JOB_CHECKPOINT_DIR, JOB_CHECKPOINT_EVERY, JOB_EVAL_DATA_PATH +
 JOB_EVAL_EVERY/JOB_EVAL_BATCHES (held-out loss/perplexity),
-JOB_ACCUM_STEPS (gradient accumulation: microbatches per optimizer step).
+JOB_ACCUM_STEPS (gradient accumulation: microbatches per optimizer step),
+JOB_OPTIMIZER ("adamw" | "adafactor" — factored second moment for
+HBM-constrained runs, trainer.TrainConfig.optimizer).
 """
 
 from __future__ import annotations
@@ -105,6 +107,7 @@ def main() -> None:
 
     tc = TrainConfig(
         accum_steps=int(os.environ.get("JOB_ACCUM_STEPS", "1")),
+        optimizer=os.environ.get("JOB_OPTIMIZER", "adamw"),
     )
     if tc.accum_steps < 1 or batch % tc.accum_steps:
         # fail in seconds, not after a 7B init on the pod — train_step

@@ -34,10 +34,39 @@ class TrainConfig:
     # accumulated in float32, ONE optimizer update — global batches larger
     # than HBM allows, numerically the full-batch step (equal micro means)
     accum_steps: int = 1
+    # "adamw" (default) or "adafactor". Adafactor (the T5 recipe: factored
+    # second moment, bf16 first moment) exists for HBM: AdamW streams f32
+    # m and v over EVERY parameter each step — ~22 bytes/param of optimizer
+    # traffic and 8 bytes/param of resident state, which for sparse MoE
+    # scales with TOTAL experts while compute scales with active ones.
+    # Factoring v to row/col statistics and keeping m in bf16 cuts the
+    # update traffic to ~8 bytes/param and the resident moments 4×, at
+    # Adafactor's (long-validated) approximation of the second moment.
+    optimizer: str = "adamw"
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
     schedule = optax.linear_schedule(0.0, tc.learning_rate, tc.warmup_steps)
+    if tc.optimizer == "adafactor":
+        return optax.chain(
+            optax.clip_by_global_norm(tc.grad_clip),
+            optax.adafactor(
+                schedule,
+                momentum=tc.beta1,
+                dtype_momentum=jnp.bfloat16,
+                # decay_rate keeps Adafactor's own 1 - t^-0.8 schedule
+                # (beta2 is an AdamW-family constant, not this knob)
+                weight_decay_rate=tc.weight_decay or None,
+                # the schedule already ramps the absolute rate; parameter
+                # scaling would additionally multiply by RMS(p) and shrink
+                # early updates of small-init layers
+                multiply_by_parameter_scale=False,
+            ),
+        )
+    if tc.optimizer != "adamw":
+        raise ValueError(
+            f"unknown optimizer {tc.optimizer!r} (adamw | adafactor)"
+        )
     return optax.chain(
         optax.clip_by_global_norm(tc.grad_clip),
         optax.adamw(
@@ -134,12 +163,23 @@ def opt_state_shardings(opt_state, params_like, target_shardings, replicated):
     """Optimizer-state pytree → shardings: subtrees structured like
     ``params_like`` get ``target_shardings``, everything else replicates.
     Shared by the full-training and LoRA sharded steps (optimizer moments
-    always mirror whatever pytree is being optimized)."""
+    always mirror whatever pytree is being optimized).
+
+    Leaves whose shape does NOT match their parameter replicate even
+    inside a params-shaped subtree: Adafactor's factored second-moment
+    trees mirror the params STRUCTURE but hold row/col reductions (and
+    (1,) placeholders), where a full-rank PartitionSpec would be
+    malformed — and at O(d + ff) per matrix they are cheap to replicate."""
     template_treedef = jax.tree.structure(params_like)
 
     def rec(node):
         if jax.tree.structure(node) == template_treedef:
-            return target_shardings
+            return jax.tree.map(
+                lambda leaf, p, s: (
+                    s if getattr(leaf, "shape", None) == p.shape else replicated
+                ),
+                node, params_like, target_shardings,
+            )
         if hasattr(node, "_fields"):  # NamedTuple (optax states) — must
             return type(node)(*(rec(x) for x in node))  # precede tuple
         if isinstance(node, tuple):
