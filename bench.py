@@ -283,6 +283,16 @@ def measure_train(model_name: str, batch: int, seq: int, steps: int,
         # honor the requested seq exactly (extend max_seq if needed) — a
         # silent clamp would compare different workloads across rounds
         cfg = replace(cfg, max_seq=seq)
+    dispatch_env = os.environ.get("BENCH_MOE_DISPATCH", "").strip().lower()
+    if dispatch_env and hasattr(cfg, "dispatch_mode"):
+        # grouped|gather|einsum — the dropless-vs-capacity experiment;
+        # fail before init/compile, not minutes in at trace time
+        if dispatch_env not in ("grouped", "gather", "einsum"):
+            raise ValueError(
+                f"BENCH_MOE_DISPATCH={dispatch_env!r} "
+                "(want grouped | gather | einsum)"
+            )
+        cfg = replace(cfg, dispatch_mode=dispatch_env)
     remat_env = os.environ.get("BENCH_REMAT", "").lower()
     if remat_env:
         # rematerialization trades FLOPs for memory; when the bench shape
@@ -330,7 +340,7 @@ def measure_train(model_name: str, batch: int, seq: int, steps: int,
     mfu = tokens_per_sec * flops_per_token / peak if peak else 0.0
     log(f"{model_name}: step_time={step_time*1e3:.1f}ms "
         f"tokens/s/chip={tokens_per_sec:.0f} mfu={mfu:.3f}")
-    return {
+    out = {
         "model": model_name,
         "mfu": round(mfu, 4),
         "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
@@ -341,6 +351,13 @@ def measure_train(model_name: str, batch: int, seq: int, steps: int,
         "seq": seq,
         "final_loss": round(float(loss), 4),
     }
+    # experiment provenance: without these, result lines from a
+    # dispatch/optimizer sweep are indistinguishable across variants
+    if hasattr(cfg, "dispatch_mode"):
+        out["dispatch_mode"] = cfg.dispatch_mode
+    if tc.optimizer != "adamw":
+        out["optimizer"] = tc.optimizer
+    return out
 
 
 def decode_roofline_seconds(cfg, param_bytes: int, batch: int,
