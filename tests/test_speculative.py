@@ -1,0 +1,119 @@
+"""Speculative decoding tests. The load-bearing property: greedy
+speculative output is EXACTLY the target model's own greedy decode,
+no matter what the draft model proposes."""
+
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_kubernetes.models import CONFIGS, init_params
+from tpu_kubernetes.models.decode import decode_step, generate, prefill
+from tpu_kubernetes.models.decode import decode_chunk
+from tpu_kubernetes.models.speculative import speculative_generate
+
+CFG = CONFIGS["llama-test"]
+MAX_NEW = 12
+
+
+@pytest.fixture(scope="module")
+def target_params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def prompt():
+    return jax.random.randint(jax.random.PRNGKey(9), (1, 7), 0, CFG.vocab_size)
+
+
+@pytest.fixture(scope="module")
+def oracle(target_params, prompt):
+    return np.asarray(
+        generate(target_params, prompt, CFG, max_new_tokens=MAX_NEW)
+    )
+
+
+def test_chunk_decode_matches_sequential_steps(target_params, prompt):
+    """decode_chunk(c tokens) == c sequential decode_steps (same cache
+    shapes) — the verification primitive must be exact."""
+    logits, cache = prefill(target_params, prompt, CFG, max_seq=32)
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    chunk = [tok]
+    seq_logits = []
+    c_step = cache
+    for _ in range(3):
+        lg, c_step = decode_step(target_params, c_step, chunk[-1], CFG)
+        seq_logits.append(lg)
+        chunk.append(jnp.argmax(lg, axis=-1).astype(jnp.int32))
+    chunk_logits, c_chunk = decode_chunk(
+        target_params, cache, jnp.stack(chunk[:3], axis=1), CFG
+    )
+    np.testing.assert_allclose(
+        np.asarray(chunk_logits),
+        np.asarray(jnp.stack(seq_logits, axis=1)),
+        atol=2e-2, rtol=2e-2,
+    )
+    assert int(c_chunk.length) == int(c_step.length)
+
+
+def test_perfect_draft_exact_and_fast(target_params, prompt, oracle):
+    """Draft == target: every proposal accepted, so each round emits
+    draft_k+1 tokens and the output is the oracle exactly."""
+    out, stats = speculative_generate(
+        target_params, target_params, prompt, CFG, CFG, MAX_NEW, draft_k=3
+    )
+    np.testing.assert_array_equal(np.asarray(out), oracle)
+    assert int(stats.accepted) == int(stats.drafted)
+    # 1 prefill token + rounds × (k+1) ≥ MAX_NEW with full acceptance
+    assert int(stats.rounds) == -(-(MAX_NEW - 1) // 4)
+
+
+def test_random_draft_still_exact(target_params, prompt, oracle):
+    """A draft that knows nothing about the target (independent random
+    init) may be rejected constantly — the output must not change."""
+    draft_params = init_params(jax.random.PRNGKey(123), CFG)
+    out, stats = speculative_generate(
+        target_params, draft_params, prompt, CFG, CFG, MAX_NEW, draft_k=4
+    )
+    np.testing.assert_array_equal(np.asarray(out), oracle)
+    assert int(stats.rounds) <= MAX_NEW
+
+def test_smaller_draft_config_exact(target_params, prompt, oracle):
+    """The draft can be a different architecture entirely (fewer layers/
+    heads) — exactness is a property of the acceptance rule."""
+    draft_cfg = replace(CFG, n_layers=1, d_ff=64)
+    draft_params = init_params(jax.random.PRNGKey(5), draft_cfg)
+    out, _ = speculative_generate(
+        target_params, draft_params, prompt, CFG, draft_cfg, MAX_NEW,
+        draft_k=2,
+    )
+    np.testing.assert_array_equal(np.asarray(out), oracle)
+
+
+def test_jittable(target_params, prompt, oracle):
+    import functools
+
+    fn = jax.jit(functools.partial(
+        speculative_generate, cfg=CFG, draft_cfg=CFG,
+        max_new_tokens=MAX_NEW, draft_k=3,
+    ))
+    out, _ = fn(target_params, target_params, prompt)
+    np.testing.assert_array_equal(np.asarray(out), oracle)
+
+
+def test_single_new_token(target_params, prompt, oracle):
+    out, stats = speculative_generate(
+        target_params, target_params, prompt, CFG, CFG, 1, draft_k=2
+    )
+    np.testing.assert_array_equal(np.asarray(out), oracle[:, :1])
+    assert int(stats.rounds) == 0
+
+
+def test_batch_gt1_rejected(target_params):
+    two = jnp.zeros((2, 4), jnp.int32)
+    with pytest.raises(ValueError, match="batch-1"):
+        speculative_generate(
+            target_params, target_params, two, CFG, CFG, 4
+        )

@@ -16,10 +16,15 @@ from tpu_kubernetes.models import llama as _llama
 from tpu_kubernetes.models import moe as _moe
 from tpu_kubernetes.models.decode import (  # noqa: F401
     KVCache,
+    decode_chunk,
     decode_step,
     generate,
     init_cache,
     prefill,
+)
+from tpu_kubernetes.models.speculative import (  # noqa: F401
+    SpecStats,
+    speculative_generate,
 )
 from tpu_kubernetes.models.llama import ModelConfig  # noqa: F401
 from tpu_kubernetes.models.llama import param_count  # noqa: F401

@@ -104,55 +104,66 @@ def _mlp(cfg: ModelConfig, x: jax.Array, layer: dict) -> jax.Array:
     return x + gated @ _w(layer["w_down"], cfg.dtype)
 
 
-def _attend_cache(cfg, q, k_cache, v_cache, valid_len,
+def _attend_cache(cfg, q, k_cache, v_cache, limits,
                   prompt_lengths=None, prompt_slots=None):
-    """Decode-side attention only: q (b, h, 1, d) against the cache
-    (b, kv, S, d); positions ≥ valid_len masked. For ragged prompt
+    """Decode-side attention only: q (b, h, c, d) against the cache
+    (b, kv, S, d); chunk row i attends slots < limits[i] (``limits``
+    (c,) shared across the batch, or (b, c) per-row) — causal within a
+    multi-token chunk, full against the history. For ragged prompt
     batches the pad slots between a row's real prompt and the uniform
     generation region are masked too (see KVCache). GQA: query heads are
     grouped over their KV head inside the einsum (no repeated cache).
     Prefill goes through the training flash kernel instead."""
     h, kv = cfg.n_heads, cfg.n_kv_heads
-    b, _, _, hd = q.shape
+    b, _, c, hd = q.shape
     rep = h // kv
-    qg = q.reshape(b, kv, rep, hd).astype(jnp.float32)       # (b, kv, rep, d)
+    qg = q.reshape(b, kv, rep, c, hd).astype(jnp.float32)
     s = jnp.einsum(
-        "bkrd,bksd->bkrs", qg, k_cache.astype(jnp.float32)
+        "bkrcd,bksd->bkrcs", qg, k_cache.astype(jnp.float32)
     ) * (1.0 / (cfg.head_dim ** 0.5))
     slots = jnp.arange(k_cache.shape[2])
-    mask = slots < valid_len                                 # (S,) | (b, S)
+    mask = slots < limits[..., None]                # (c, S) | (b, c, S)
     if prompt_lengths is not None:
-        mask = mask & (
+        # ragged batches are single-token (limits (b, 1), mask (b, 1, S))
+        real = (
             (slots[None, :] < prompt_lengths[:, None])
             | (slots[None, :] >= prompt_slots)
-        )
-        s = jnp.where(mask[:, None, None, :], s, -1e30)
-    else:
-        s = jnp.where(mask[None, None, None, :], s, -1e30)
+        )                                           # (b, S)
+        mask = mask & real[:, None, :]
+    if mask.ndim == 2:                              # shared across batch
+        s = jnp.where(mask[None, None, None], s, -1e30)
+    else:                                           # per-row
+        s = jnp.where(mask[:, None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bkrs,bksd->bkrd", p, v_cache.astype(jnp.float32))
-    return out.reshape(b, h, 1, hd).astype(q.dtype)
+    out = jnp.einsum("bkrcs,bksd->bkrcd", p, v_cache.astype(jnp.float32))
+    return out.reshape(b, h, c, hd).astype(q.dtype)
 
 
 def _decode_block(cfg, cos, sin, pos, li, x, layer, k_all, v_all,
                   prompt_lengths=None, prompt_slots=None):
-    """One layer, one token. x: (b, 1, d); the FULL stacked cache
-    (L, b, kv, S, d) is threaded through and layer ``li``'s slice updated
-    in place at ``pos`` (one-position dynamic_update_slice on the scan
-    carry — see module docstring). → (x, k_all, v_all)."""
-    b = x.shape[0]
+    """One layer, one chunk of c tokens at slots ``pos .. pos+c-1``.
+    x: (b, c, d); the FULL stacked cache (L, b, kv, S, d) is threaded
+    through and layer ``li``'s slice updated in place (one c-position
+    dynamic_update_slice on the scan carry — see module docstring).
+    c == 1 is the classic decode step; c > 1 is chunk verification
+    (ragged prompts are single-token only). → (x, k_all, v_all)."""
+    b, c, _ = x.shape
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     y = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (y @ _w(layer["wq"], cfg.dtype)).reshape(b, 1, h, hd).transpose(0, 2, 1, 3)
-    k = (y @ _w(layer["wk"], cfg.dtype)).reshape(b, 1, kv, hd).transpose(0, 2, 1, 3)
-    v = (y @ _w(layer["wv"], cfg.dtype)).reshape(b, 1, kv, hd).transpose(0, 2, 1, 3)
+    q = (y @ _w(layer["wq"], cfg.dtype)).reshape(b, c, h, hd).transpose(0, 2, 1, 3)
+    k = (y @ _w(layer["wk"], cfg.dtype)).reshape(b, c, kv, hd).transpose(0, 2, 1, 3)
+    v = (y @ _w(layer["wv"], cfg.dtype)).reshape(b, c, kv, hd).transpose(0, 2, 1, 3)
     if prompt_lengths is not None:
         # ragged rows: the token in SLOT pos is row i's LOGICAL position
         # prompt_lengths[i] + (pos - prompt_slots) — gapless per row
         positions = (prompt_lengths + (pos - prompt_slots))[:, None]  # (b, 1)
+        limits = (pos + 1)[None, None]                       # (1, 1) → (b, c)
+        limits = jnp.broadcast_to(limits, (b, 1))
     else:
-        positions = pos[None]                                # (1,)
+        positions = pos + jnp.arange(c, dtype=jnp.int32)     # (c,)
+        # chunk row i sees the history plus chunk rows ≤ i
+        limits = positions + 1
     q = apply_rope(q, cos, sin, positions=positions)
     k = apply_rope(k, cos, sin, positions=positions)
 
@@ -161,9 +172,9 @@ def _decode_block(cfg, cos, sin, pos, li, x, layer, k_all, v_all,
     k_cache = jax.lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)
     v_cache = jax.lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)
 
-    attn = _attend_cache(cfg, q, k_cache, v_cache, pos + 1,
+    attn = _attend_cache(cfg, q, k_cache, v_cache, limits,
                          prompt_lengths, prompt_slots)
-    attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, h * hd)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, c, h * hd)
     x = x + attn @ _w(layer["wo"], cfg.dtype)
     return _mlp(cfg, x, layer), k_all, v_all
 
@@ -268,6 +279,47 @@ def decode_step(
         k=k_new, v=v_new, length=pos + 1,
         prompt_lengths=cache.prompt_lengths, prompt_slots=cache.prompt_slots,
     )
+
+
+def decode_chunk(
+    params: dict, cache: KVCache, tokens: jax.Array, cfg: ModelConfig
+) -> tuple[jax.Array, KVCache]:
+    """Process ``c`` tokens at positions ``cache.length .. +c-1`` in ONE
+    forward: tokens (b, c) int32 → (logits (b, c, vocab) f32 — one set
+    per chunk position — cache advanced by c).
+
+    The multi-token generalization of :func:`decode_step` (c=1 is the
+    same computation, through the same ``_decode_block``): chunk K/V are
+    written into the cache first, then each chunk row attends all cache
+    slots below its own position — causal within the chunk, full against
+    the history. This is the verification primitive for speculative
+    decoding (models/speculative.py), where the target model scores k
+    draft tokens in one pass instead of k sequential steps. Uniform
+    batches only (no ragged prompts)."""
+    if cache.prompt_lengths is not None:
+        raise ValueError("decode_chunk supports uniform batches only")
+    c = tokens.shape[1]
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    pos = cache.length
+    x = params["embed"][tokens]                                 # (b, c, d)
+
+    def block(carry, xs):
+        x, k_all, v_all = carry
+        layer, li = xs
+        x, k_all, v_all = _decode_block(
+            cfg, cos, sin, pos, li, x, layer, k_all, v_all
+        )
+        return (x, k_all, v_all), None
+
+    n_layers = cache.k.shape[0]
+    (x, k_new, v_new), _ = jax.lax.scan(
+        block,
+        (x, cache.k, cache.v),
+        (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
+    return logits, KVCache(k=k_new, v=v_new, length=pos + c)
 
 
 def _sample(logits: jax.Array, rng: jax.Array, temperature: float,

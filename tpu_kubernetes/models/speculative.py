@@ -1,0 +1,164 @@
+"""Speculative decoding: draft-model assisted greedy generation.
+
+A small draft model proposes ``draft_k`` tokens sequentially; the target
+model scores all of them in ONE ``decode_chunk`` forward (models/decode.py)
+and keeps the longest prefix it agrees with, plus its own correction token
+— so each target pass emits between 1 and draft_k+1 tokens. Greedy
+(temperature 0) acceptance makes the output **exactly** the target
+model's own greedy decode, whatever the draft proposes; that invariant is
+pinned in tests/test_speculative.py. A good draft turns the HBM-bound
+per-token weight stream into one stream per ~(1+accepted) tokens.
+
+TPU-first shape discipline:
+
+* The token budget is a static ``(1, max_new_tokens)`` buffer; accepted
+  tokens land via masked out-of-bounds-dropping scatters, never a
+  data-dependent shape.
+* One ``lax.while_loop`` over rounds (each emits ≥ 1 token, so it
+  terminates in ≤ max_new rounds); everything inside is fixed-shape:
+  k sequential draft steps, one (k+1)-token target chunk, prefix-match
+  acceptance as a cumprod.
+* Cache rollback is O(1): both KV caches are allocated once and "rolled
+  back" by rewinding ``length`` — stale slots above it are masked out of
+  attention and overwritten by the next round's writes.
+
+Batch 1 only (per-row acceptance counts would need per-row cache
+cursors); the standard configuration for assisted generation. The
+reference provisioner has no inference plane (SURVEY §0); this extends
+the serving stack models/decode.py established.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_kubernetes.models.decode import decode_chunk, decode_step, prefill
+from tpu_kubernetes.models.llama import ModelConfig
+
+
+class SpecStats(NamedTuple):
+    """rounds: target chunk passes run; drafted: draft tokens proposed;
+    accepted: draft tokens the target agreed with (the speedup signal:
+    tokens-per-target-pass = emitted / rounds)."""
+
+    rounds: jax.Array
+    drafted: jax.Array
+    accepted: jax.Array
+
+
+def speculative_generate(
+    params: dict,
+    draft_params: dict,
+    prompt: jax.Array,
+    cfg: ModelConfig,
+    draft_cfg: ModelConfig,
+    max_new_tokens: int,
+    *,
+    draft_k: int = 4,
+) -> tuple[jax.Array, SpecStats]:
+    """prompt (1, prompt_len) int32 → ((1, max_new_tokens) int32, stats).
+
+    Greedy speculative decoding; the emitted tokens are exactly
+    ``generate(params, prompt, cfg, max_new_tokens)`` (temperature 0).
+    Jittable end to end with static cfg/max_new_tokens/draft_k.
+    """
+    if prompt.shape[0] != 1:
+        raise ValueError(
+            f"speculative decoding is batch-1 only, got batch {prompt.shape[0]}"
+        )
+    if draft_k < 1:
+        raise ValueError(f"draft_k must be >= 1, got {draft_k}")
+    from tpu_kubernetes.models.moe import MoEConfig
+
+    if isinstance(cfg, MoEConfig):
+        # MoE expert capacity is computed per forward chunk, so a (k+1)-
+        # token verification pass can drop tokens sequential decode would
+        # keep — silently voiding the exactness guarantee. Refuse loudly.
+        # (A MoE *draft* is fine: drafts only propose, never verify.)
+        raise ValueError(
+            "speculative verification requires a dense target model "
+            "(MoE capacity semantics are chunk-size-dependent)"
+        )
+    plen = prompt.shape[1]
+    # chunk writes can transiently reach plen + max_new - 1 + draft_k
+    span = plen + max_new_tokens + draft_k
+    for name, c in (("target", cfg), ("draft", draft_cfg)):
+        if span > c.max_seq:
+            raise ValueError(
+                f"prompt {plen} + new {max_new_tokens} + draft_k {draft_k} "
+                f"exceeds {name} max_seq {c.max_seq}"
+            )
+
+    logits, cache_t = prefill(params, prompt, cfg, max_seq=span)
+    _, cache_d = prefill(draft_params, prompt, draft_cfg, max_seq=span)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]    # ()
+
+    out = jnp.zeros((max_new_tokens,), jnp.int32).at[0].set(first)
+    k = draft_k
+
+    def cond(carry):
+        _, cursor, *_ = carry
+        return cursor < max_new_tokens
+
+    def body(carry):
+        out, cursor, last, cache_t, cache_d, stats = carry
+
+        # invariant: both caches hold positions < plen + cursor - 1 + 1
+        # == everything before `last`; `last` sits at plen + cursor - 1
+        def dstep(c, _):
+            cache_d, tok = c
+            lg, cache_d = decode_step(
+                draft_params, cache_d, tok[None], draft_cfg
+            )
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)[0]
+            return (cache_d, nxt), nxt
+
+        (cache_d, _), drafts = jax.lax.scan(
+            dstep, (cache_d, last), None, length=k
+        )                                                        # (k,)
+        # the draft never processed its own last proposal — one step so
+        # the full-acceptance case finds d_k's K/V in the cache next round
+        _, cache_d = decode_step(
+            draft_params, cache_d, drafts[k - 1][None], draft_cfg
+        )
+
+        chunk = jnp.concatenate([last[None], drafts])            # (k+1,)
+        logits_c, cache_t = decode_chunk(params, cache_t, chunk[None], cfg)
+        greedy = jnp.argmax(logits_c[0], axis=-1).astype(jnp.int32)  # (k+1,)
+
+        matches = (drafts == greedy[:k]).astype(jnp.int32)
+        j = jnp.sum(jnp.cumprod(matches))                        # 0..k
+        n_emit = jnp.minimum(j + 1, max_new_tokens - cursor)
+
+        # the emitted tokens ARE the target's greedy choices: for i < j
+        # drafts[i] == greedy[i] by definition of the matched prefix, and
+        # position j takes the target's correction greedy[j]
+        idx = jnp.arange(k + 1, dtype=jnp.int32)
+        write_at = jnp.where(idx < n_emit, cursor + idx, max_new_tokens)
+        out = out.at[write_at].set(greedy, mode="drop")
+        last = greedy[n_emit - 1]
+        cursor = cursor + n_emit
+
+        # rewind both caches to "everything before the new last token";
+        # stale higher slots are masked out and overwritten next round
+        valid = plen + cursor - 1
+        cache_t = cache_t._replace(length=valid)
+        cache_d = cache_d._replace(length=valid)
+        stats = SpecStats(
+            rounds=stats.rounds + 1,
+            drafted=stats.drafted + k,
+            accepted=stats.accepted + j,
+        )
+        return out, cursor, last, cache_t, cache_d, stats
+
+    zero = jnp.zeros((), jnp.int32)
+    stats0 = SpecStats(rounds=zero, drafted=zero, accepted=zero)
+    out, _, _, _, _, stats = jax.lax.while_loop(
+        cond,
+        body,
+        (out, jnp.asarray(1, jnp.int32), first, cache_t, cache_d, stats0),
+    )
+    return out[None, :], stats
