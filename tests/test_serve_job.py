@@ -51,6 +51,35 @@ def test_int8_mode_runs(tmp_path, prompts_file):
     assert len(completions) == 3
 
 
+def test_speculative_mode_matches_plain_greedy(tmp_path, prompts_file):
+    """SERVE_DRAFT_MODEL flips to draft-assisted decoding; completions
+    must be token-identical to the plain greedy path (models/speculative's
+    exactness guarantee carried through the entrypoint)."""
+    plain = run_serving(_env(prompts_file, tmp_path / "a.txt"))
+    spec = run_serving(_env(
+        prompts_file, tmp_path / "b.txt",
+        SERVE_DRAFT_MODEL="llama-test", SERVE_DRAFT_K="3",
+    ))
+    assert spec == plain
+
+
+def test_speculative_rejects_sampling(tmp_path, prompts_file):
+    with pytest.raises(SystemExit, match="greedy"):
+        run_serving(_env(
+            prompts_file, tmp_path / "o.txt",
+            SERVE_DRAFT_MODEL="llama-test", SERVE_TEMPERATURE="0.7",
+        ))
+
+
+def test_speculative_rejects_moe_target(tmp_path, prompts_file):
+    with pytest.raises(SystemExit, match="dense TARGET"):
+        run_serving(_env(
+            prompts_file, tmp_path / "o.txt",
+            SERVE_MODEL="moe-test", SERVE_DRAFT_MODEL="llama-test",
+            SERVE_MAX_NEW="4",
+        ))
+
+
 def test_missing_prompts_rejected(tmp_path):
     with pytest.raises(SystemExit, match="SERVE_PROMPTS"):
         run_serving({"SERVE_MODEL": "llama-test"})

@@ -30,6 +30,14 @@ whole pipeline is env-driven like the trainer:
   SERVE_TEMPERATURE / SERVE_TOP_K / SERVE_TOP_P / SERVE_SEED
   SERVE_EOS_ID         stop rows at this token (emitted tokens after it
                        are dropped from the text)
+  SERVE_DRAFT_MODEL /  enable SPECULATIVE decoding: the draft preset /
+  SERVE_DRAFT_HF_CHECKPOINT  local transformers dir proposes
+                       SERVE_DRAFT_K (default 4) tokens per target pass
+                       (models/speculative.py). Greedy only
+                       (SERVE_TEMPERATURE must stay 0), batch-1 per
+                       prompt (one retrace per distinct prompt length),
+                       single-device (SERVE_MESH ignored); output is
+                       token-identical to the non-draft greedy path.
 
 The reference provisioner has no inference plane (SURVEY §0); this
 completes the in-tree stack's serving story end to end (provision →
@@ -145,38 +153,105 @@ def run_serving(env: dict | None = None) -> list[str]:
             f"exceeds the model's max_seq {cfg.max_seq}"
         )
 
-    fn, p_sh, b_sh = make_sharded_generate(
-        cfg, mesh, params, max_new_tokens=max_new,
-        temperature=float(env.get("SERVE_TEMPERATURE", "0")),
-        top_k=int(env.get("SERVE_TOP_K", "0")),
-        top_p=float(env.get("SERVE_TOP_P", "0")),
-        eos_id=eos_id, pad_id=pad_id,
-    )
-    params = jax.device_put(params, p_sh)
-    rng = jax.random.PRNGKey(int(env.get("SERVE_SEED", "0")))
+    def finish(row_ids) -> None:
+        ids = list(row_ids)
+        if eos_id is not None and eos_id in ids:
+            ids = ids[:ids.index(eos_id)]
+        nonlocal n_tokens
+        n_tokens += len(ids)
+        completions.append(decode_text(ids))
 
     completions: list[str] = []
     n_tokens = 0
-    t0 = time.perf_counter()
-    for start in range(0, len(token_rows), batch_rows):
-        rows = token_rows[start:start + batch_rows]
-        n_real = len(rows)
-        rows = rows + [rows[-1]] * (batch_rows - n_real)  # pad the batch
-        lengths = jnp.asarray([len(r) for r in rows], jnp.int32)
-        padded = np.zeros((batch_rows, width), np.int32)
-        for i, r in enumerate(rows):
-            padded[i, :len(r)] = r
-        rng, call_rng = jax.random.split(rng)
-        out = fn(
-            params, jax.device_put(jnp.asarray(padded), b_sh),
-            rng=call_rng, prompt_lengths=lengths,
+    draft_hf = env.get("SERVE_DRAFT_HF_CHECKPOINT", "")
+    draft_name = env.get("SERVE_DRAFT_MODEL", "")
+    if draft_hf or draft_name:
+        # --- speculative decoding: batch-1, greedy, single-device ------
+        # cheap config rejections first — before any checkpoint I/O
+        if float(env.get("SERVE_TEMPERATURE", "0")) != 0.0:
+            raise SystemExit(
+                "speculative decoding is greedy: unset SERVE_TEMPERATURE "
+                "or drop the SERVE_DRAFT_* config"
+            )
+        import functools
+
+        from tpu_kubernetes.models import MoEConfig, speculative_generate
+
+        if isinstance(cfg, MoEConfig):
+            raise SystemExit(
+                "speculative decoding needs a dense TARGET model (MoE "
+                "chunk verification is not token-exact); MoE drafts are fine"
+            )
+        draft_k = int(env.get("SERVE_DRAFT_K", "4"))
+        if width + max_new + draft_k > cfg.max_seq:
+            raise SystemExit(
+                f"longest prompt ({width}) + SERVE_MAX_NEW ({max_new}) "
+                f"+ SERVE_DRAFT_K ({draft_k}) exceeds the target "
+                f"model's max_seq {cfg.max_seq}"
+            )
+
+        if draft_hf:
+            from tpu_kubernetes.models import load_hf
+
+            draft_params, draft_cfg = load_hf(draft_hf)
+            log(f"draft: HF checkpoint {draft_hf}")
+        else:
+            draft_cfg = CONFIGS[draft_name]
+            draft_params = init_params(jax.random.PRNGKey(1), draft_cfg)
+            log(f"draft: random-init {draft_name} (smoke mode)")
+        if draft_cfg.vocab_size != cfg.vocab_size:
+            raise SystemExit(
+                f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                f"{cfg.vocab_size} — the models must share a tokenizer"
+            )
+        if width + max_new + draft_k > draft_cfg.max_seq:
+            raise SystemExit(
+                f"longest prompt ({width}) + SERVE_MAX_NEW ({max_new}) "
+                f"+ SERVE_DRAFT_K ({draft_k}) exceeds the draft "
+                f"model's max_seq {draft_cfg.max_seq}"
+            )
+        t0 = time.perf_counter()
+        spec = jax.jit(functools.partial(
+            speculative_generate, cfg=cfg, draft_cfg=draft_cfg,
+            max_new_tokens=max_new, draft_k=draft_k,
+        ))
+        drafted = accepted = 0
+        for row in token_rows:
+            out, stats = spec(
+                params, draft_params, jnp.asarray([row], jnp.int32)
+            )
+            drafted += int(stats.drafted)
+            accepted += int(stats.accepted)
+            finish(np.asarray(out)[0].tolist())
+        log(f"speculative: k={draft_k}, accepted {accepted}/{drafted} "
+            f"({accepted / max(1, drafted):.0%})")
+    else:
+        fn, p_sh, b_sh = make_sharded_generate(
+            cfg, mesh, params, max_new_tokens=max_new,
+            temperature=float(env.get("SERVE_TEMPERATURE", "0")),
+            top_k=int(env.get("SERVE_TOP_K", "0")),
+            top_p=float(env.get("SERVE_TOP_P", "0")),
+            eos_id=eos_id, pad_id=pad_id,
         )
-        for row in np.asarray(out)[:n_real]:
-            ids = row.tolist()
-            if eos_id is not None and eos_id in ids:
-                ids = ids[:ids.index(eos_id)]
-            n_tokens += len(ids)
-            completions.append(decode_text(ids))
+        params = jax.device_put(params, p_sh)
+        rng = jax.random.PRNGKey(int(env.get("SERVE_SEED", "0")))
+
+        t0 = time.perf_counter()
+        for start in range(0, len(token_rows), batch_rows):
+            rows = token_rows[start:start + batch_rows]
+            n_real = len(rows)
+            rows = rows + [rows[-1]] * (batch_rows - n_real)  # pad the batch
+            lengths = jnp.asarray([len(r) for r in rows], jnp.int32)
+            padded = np.zeros((batch_rows, width), np.int32)
+            for i, r in enumerate(rows):
+                padded[i, :len(r)] = r
+            rng, call_rng = jax.random.split(rng)
+            out = fn(
+                params, jax.device_put(jnp.asarray(padded), b_sh),
+                rng=call_rng, prompt_lengths=lengths,
+            )
+            for row in np.asarray(out)[:n_real]:
+                finish(row.tolist())
     dt = time.perf_counter() - t0
     log(f"{len(prompts)} prompts, {n_tokens} tokens "
         f"in {dt:.1f}s ({n_tokens / dt:.0f} tok/s)")
