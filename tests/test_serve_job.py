@@ -54,13 +54,47 @@ def test_int8_mode_runs(tmp_path, prompts_file):
 def test_speculative_mode_matches_plain_greedy(tmp_path, prompts_file):
     """SERVE_DRAFT_MODEL flips to draft-assisted decoding; completions
     must be token-identical to the plain greedy path (models/speculative's
-    exactness guarantee carried through the entrypoint)."""
-    plain = run_serving(_env(prompts_file, tmp_path / "a.txt"))
+    exactness guarantee carried through the entrypoint). The plain run
+    pins SERVE_CACHE_SPAN to the speculative allocation (width 12 + new 6
+    + k) — different KV spans can flip near-tied greedy argmaxes on this
+    random model (see tests/test_speculative.py)."""
+    plain = run_serving(_env(
+        prompts_file, tmp_path / "a.txt", SERVE_CACHE_SPAN="21",
+    ))
     spec = run_serving(_env(
         prompts_file, tmp_path / "b.txt",
         SERVE_DRAFT_MODEL="llama-test", SERVE_DRAFT_K="3",
     ))
     assert spec == plain
+
+
+def test_prompt_lookup_mode_matches_plain_greedy(tmp_path, prompts_file):
+    plain = run_serving(_env(
+        prompts_file, tmp_path / "a.txt", SERVE_CACHE_SPAN="22",
+    ))
+    spec = run_serving(_env(
+        prompts_file, tmp_path / "b.txt",
+        SERVE_PROMPT_LOOKUP="1", SERVE_DRAFT_K="4",
+    ))
+    assert spec == plain
+
+
+def test_prompt_lookup_disabled_by_falsy_values(tmp_path, prompts_file):
+    """SERVE_PROMPT_LOOKUP=0/false must NOT enable the mode (it would
+    silently reject sampling temperatures and drop to batch-1)."""
+    out = run_serving(_env(
+        prompts_file, tmp_path / "o.txt",
+        SERVE_PROMPT_LOOKUP="0", SERVE_TEMPERATURE="0.7",
+    ))
+    assert len(out) == 3
+
+
+def test_lookup_and_draft_exclusive(tmp_path, prompts_file):
+    with pytest.raises(SystemExit, match="exclusive"):
+        run_serving(_env(
+            prompts_file, tmp_path / "o.txt",
+            SERVE_PROMPT_LOOKUP="1", SERVE_DRAFT_MODEL="llama-test",
+        ))
 
 
 def test_speculative_rejects_sampling(tmp_path, prompts_file):

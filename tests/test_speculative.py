@@ -1,6 +1,12 @@
 """Speculative decoding tests. The load-bearing property: greedy
 speculative output is EXACTLY the target model's own greedy decode,
-no matter what the draft model proposes."""
+no matter what the draft model proposes.
+
+"Exactly" is bitwise at the SAME KV-cache span: speculative allocates
+prompt+new+draft_k slots, and cache size changes XLA's attention
+reduction order — near-tied logits on this random tiny model CAN argmax
+differently across spans (observed), so each oracle below pins
+``generate(..., cache_span=...)`` to its test's span."""
 
 from dataclasses import replace
 
@@ -29,10 +35,15 @@ def prompt():
 
 
 @pytest.fixture(scope="module")
-def oracle(target_params, prompt):
-    return np.asarray(
-        generate(target_params, prompt, CFG, max_new_tokens=MAX_NEW)
-    )
+def oracle_at(target_params, prompt):
+    def _oracle(draft_k, max_new=MAX_NEW, p=None):
+        p = prompt if p is None else p
+        return np.asarray(generate(
+            target_params, p, CFG, max_new_tokens=max_new,
+            cache_span=p.shape[1] + max_new + draft_k,
+        ))
+
+    return _oracle
 
 
 def test_chunk_decode_matches_sequential_steps(target_params, prompt):
@@ -58,29 +69,29 @@ def test_chunk_decode_matches_sequential_steps(target_params, prompt):
     assert int(c_chunk.length) == int(c_step.length)
 
 
-def test_perfect_draft_exact_and_fast(target_params, prompt, oracle):
+def test_perfect_draft_exact_and_fast(target_params, prompt, oracle_at):
     """Draft == target: every proposal accepted, so each round emits
     draft_k+1 tokens and the output is the oracle exactly."""
     out, stats = speculative_generate(
         target_params, target_params, prompt, CFG, CFG, MAX_NEW, draft_k=3
     )
-    np.testing.assert_array_equal(np.asarray(out), oracle)
+    np.testing.assert_array_equal(np.asarray(out), oracle_at(3))
     assert int(stats.accepted) == int(stats.drafted)
     # 1 prefill token + rounds × (k+1) ≥ MAX_NEW with full acceptance
     assert int(stats.rounds) == -(-(MAX_NEW - 1) // 4)
 
 
-def test_random_draft_still_exact(target_params, prompt, oracle):
+def test_random_draft_still_exact(target_params, prompt, oracle_at):
     """A draft that knows nothing about the target (independent random
     init) may be rejected constantly — the output must not change."""
     draft_params = init_params(jax.random.PRNGKey(123), CFG)
     out, stats = speculative_generate(
         target_params, draft_params, prompt, CFG, CFG, MAX_NEW, draft_k=4
     )
-    np.testing.assert_array_equal(np.asarray(out), oracle)
+    np.testing.assert_array_equal(np.asarray(out), oracle_at(4))
     assert int(stats.rounds) <= MAX_NEW
 
-def test_smaller_draft_config_exact(target_params, prompt, oracle):
+def test_smaller_draft_config_exact(target_params, prompt, oracle_at):
     """The draft can be a different architecture entirely (fewer layers/
     heads) — exactness is a property of the acceptance rule."""
     draft_cfg = replace(CFG, n_layers=1, d_ff=64)
@@ -89,10 +100,10 @@ def test_smaller_draft_config_exact(target_params, prompt, oracle):
         target_params, draft_params, prompt, CFG, draft_cfg, MAX_NEW,
         draft_k=2,
     )
-    np.testing.assert_array_equal(np.asarray(out), oracle)
+    np.testing.assert_array_equal(np.asarray(out), oracle_at(2))
 
 
-def test_jittable(target_params, prompt, oracle):
+def test_jittable(target_params, prompt, oracle_at):
     import functools
 
     fn = jax.jit(functools.partial(
@@ -100,15 +111,129 @@ def test_jittable(target_params, prompt, oracle):
         max_new_tokens=MAX_NEW, draft_k=3,
     ))
     out, _ = fn(target_params, target_params, prompt)
-    np.testing.assert_array_equal(np.asarray(out), oracle)
+    np.testing.assert_array_equal(np.asarray(out), oracle_at(3))
 
 
-def test_single_new_token(target_params, prompt, oracle):
+def test_single_new_token(target_params, prompt, oracle_at):
     out, stats = speculative_generate(
         target_params, target_params, prompt, CFG, CFG, 1, draft_k=2
     )
-    np.testing.assert_array_equal(np.asarray(out), oracle[:, :1])
+    np.testing.assert_array_equal(np.asarray(out), oracle_at(2, max_new=1))
     assert int(stats.rounds) == 0
+
+
+class TestNgramMatcher:
+    """Direct unit tests of the lookup matcher — the exactness loop
+    masks matcher regressions (a broken matcher just degrades to the
+    fallback), so the proposal logic is pinned here."""
+
+    def _propose(self, ctx, valid, n, k, last=99):
+        from tpu_kubernetes.models.speculative import _ngram_propose
+
+        return np.asarray(_ngram_propose(
+            jnp.asarray(ctx, jnp.int32), jnp.asarray(valid, jnp.int32),
+            n, k, jnp.asarray(last, jnp.int32),
+        ))
+
+    def test_matches_continuation(self):
+        # seen: 1 2 3 7 8 1 2 — tail (1, 2) matched at pos 0 → continue 3 7
+        ctx = [1, 2, 3, 7, 8, 1, 2, 0, 0, 0]
+        np.testing.assert_array_equal(
+            self._propose(ctx, valid=7, n=2, k=2), [3, 7]
+        )
+
+    def test_latest_match_wins(self):
+        # tail (1, 2) occurs at 0 (→3) and 3 (→4): the later one proposes
+        ctx = [1, 2, 3, 1, 2, 4, 1, 2, 0, 0]
+        np.testing.assert_array_equal(
+            self._propose(ctx, valid=8, n=2, k=1), [4]
+        )
+
+    def test_no_match_falls_back_to_last(self):
+        ctx = [1, 2, 3, 4, 5, 6, 0, 0]
+        np.testing.assert_array_equal(
+            self._propose(ctx, valid=6, n=2, k=3, last=42), [42, 42, 42]
+        )
+
+    def test_unseen_context_is_invisible(self):
+        # tokens past `valid` must not produce matches: (9, 9) appears
+        # only beyond the seen region
+        ctx = [9, 9, 1, 2, 3, 9, 9, 9, 9, 0]
+        # seen = first 5; tail (2, 3): the (9,9) repeats beyond valid are
+        # not eligible and the only (2,3) is the tail itself → fallback
+        np.testing.assert_array_equal(
+            self._propose(ctx, valid=5, n=2, k=2, last=7), [7, 7]
+        )
+
+
+class TestPromptLookup:
+    """Draft-model-free n-gram drafting — same exactness guarantee."""
+
+    def test_exact_vs_oracle(self, target_params, prompt, oracle_at):
+        from tpu_kubernetes.models import prompt_lookup_generate
+
+        out, stats = prompt_lookup_generate(
+            target_params, prompt, CFG, MAX_NEW, draft_k=5, ngram=2
+        )
+        np.testing.assert_array_equal(np.asarray(out), oracle_at(5))
+        assert int(stats.rounds) <= MAX_NEW
+
+    def test_repetitive_prompt_accepts(self, target_params):
+        """A periodic prompt makes the n-gram continuation a plausible
+        proposal; whatever is accepted, output must equal plain greedy."""
+        from tpu_kubernetes.models import prompt_lookup_generate
+
+        pat = jnp.asarray([[5, 9, 11, 5, 9, 11, 5, 9, 11, 5, 9]], jnp.int32)
+        oracle = np.asarray(generate(
+            target_params, pat, CFG, max_new_tokens=10,
+            cache_span=pat.shape[1] + 10 + 4,
+        ))
+        out, stats = prompt_lookup_generate(
+            target_params, pat, CFG, 10, draft_k=4, ngram=2
+        )
+        np.testing.assert_array_equal(np.asarray(out), oracle)
+
+    def test_short_prompt_no_match_fallback(self, target_params):
+        """ngram > prompt length exercises the no-match fallback. This
+        particular seed/prompt hits a genuine logit TIE (top-2 logits
+        within float rounding; `generate` itself emits different tokens
+        at different cache spans), so assert greedy VALIDITY — every
+        emitted token is argmax under teacher forcing within tolerance —
+        rather than bitwise equality with one arbitrary tie resolution."""
+        from tpu_kubernetes.models import forward, prompt_lookup_generate
+
+        tiny = jnp.asarray([[3]], jnp.int32)
+        out, _ = prompt_lookup_generate(
+            target_params, tiny, CFG, 6, draft_k=3, ngram=3
+        )
+        seq = jnp.concatenate([tiny, out.astype(jnp.int32)], axis=1)
+        logits = np.asarray(forward(target_params, seq[:, :-1], CFG))[0]
+        preds = logits[tiny.shape[1] - 1:]               # rows for out[i]
+        chosen = np.take_along_axis(
+            preds, np.asarray(out)[0][:, None], axis=1
+        )[:, 0]
+        assert (preds.max(axis=1) - chosen <= 5e-2).all()
+
+    def test_jittable(self, target_params, prompt, oracle_at):
+        import functools
+
+        from tpu_kubernetes.models import prompt_lookup_generate
+
+        fn = jax.jit(functools.partial(
+            prompt_lookup_generate, cfg=CFG, max_new_tokens=MAX_NEW,
+            draft_k=4, ngram=2,
+        ))
+        out, _ = fn(target_params, prompt)
+        np.testing.assert_array_equal(np.asarray(out), oracle_at(4))
+
+    def test_oversized_ngram_rejected(self, target_params):
+        from tpu_kubernetes.models import prompt_lookup_generate
+
+        tiny = jnp.asarray([[3, 4]], jnp.int32)
+        with pytest.raises(ValueError, match="ngram"):
+            prompt_lookup_generate(
+                target_params, tiny, CFG, 2, draft_k=2, ngram=10
+            )
 
 
 def test_batch_gt1_rejected(target_params):

@@ -24,6 +24,7 @@ from tpu_kubernetes.models.decode import (  # noqa: F401
 )
 from tpu_kubernetes.models.speculative import (  # noqa: F401
     SpecStats,
+    prompt_lookup_generate,
     speculative_generate,
 )
 from tpu_kubernetes.models.llama import ModelConfig  # noqa: F401

@@ -362,8 +362,15 @@ def generate(
     prompt_lengths: jax.Array | None = None,
     eos_id: int | None = None,
     pad_id: int = 0,
+    cache_span: int | None = None,
 ) -> jax.Array:
     """prompt (batch, prompt_len) int32 → (batch, max_new_tokens) int32.
+
+    ``cache_span`` overrides the KV-cache allocation (default
+    prompt_len + max_new_tokens). The cache size changes XLA's attention
+    reduction order, which can flip greedy argmax on near-tied logits —
+    pass the other program's span when comparing outputs bitwise (e.g.
+    speculative decoding allocates prompt + new + draft_k).
     Jittable end to end (prefill + lax.scan of decode steps with sampling
     folded in); wrap in jax.jit with static cfg/max_new_tokens for a
     single compiled serving program.
@@ -394,7 +401,7 @@ def generate(
     # right-size the cache: decode attends over plen+max_new positions,
     # not cfg.max_seq (static per compile, same as max_new_tokens)
     logits, cache = prefill(
-        params, prompt, cfg, max_seq=plen + max_new_tokens,
+        params, prompt, cfg, max_seq=cache_span or (plen + max_new_tokens),
         lengths=prompt_lengths,
     )
     first = _sample(logits, first_rng, temperature, top_k, top_p)

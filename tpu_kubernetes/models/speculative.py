@@ -1,13 +1,26 @@
-"""Speculative decoding: draft-model assisted greedy generation.
+"""Speculative decoding: assisted greedy generation, token-exact.
 
-A small draft model proposes ``draft_k`` tokens sequentially; the target
-model scores all of them in ONE ``decode_chunk`` forward (models/decode.py)
-and keeps the longest prefix it agrees with, plus its own correction token
-— so each target pass emits between 1 and draft_k+1 tokens. Greedy
-(temperature 0) acceptance makes the output **exactly** the target
-model's own greedy decode, whatever the draft proposes; that invariant is
-pinned in tests/test_speculative.py. A good draft turns the HBM-bound
-per-token weight stream into one stream per ~(1+accepted) tokens.
+Two drafting strategies over one verification loop:
+
+* :func:`speculative_generate` — a small DRAFT MODEL proposes ``draft_k``
+  tokens sequentially.
+* :func:`prompt_lookup_generate` — NO draft model: the most recent
+  n-gram match in the already-seen context (prompt + generated) proposes
+  its historical continuation. Free proposals; strong on extractive /
+  code / repetitive text.
+
+Either way the target model scores all proposals in ONE ``decode_chunk``
+forward (models/decode.py) and keeps the longest prefix it agrees with,
+plus its own correction token — so each target pass emits between 1 and
+draft_k+1 tokens. Greedy (temperature 0) acceptance makes the output
+**exactly** the target model's own greedy decode, whatever the draft
+proposes; that invariant is pinned in tests/test_speculative.py. One
+precision caveat: "exactly" means the greedy decode at the SAME KV-cache
+span (``generate(..., cache_span=prompt+new+draft_k)``) — cache size
+changes XLA's attention reduction order, and differently-sized programs
+can round near-tied logits to different argmaxes. A good draft turns the
+HBM-bound per-token weight stream into one stream per ~(1+accepted)
+tokens.
 
 TPU-first shape discipline:
 
@@ -16,10 +29,10 @@ TPU-first shape discipline:
   data-dependent shape.
 * One ``lax.while_loop`` over rounds (each emits ≥ 1 token, so it
   terminates in ≤ max_new rounds); everything inside is fixed-shape:
-  k sequential draft steps, one (k+1)-token target chunk, prefix-match
-  acceptance as a cumprod.
-* Cache rollback is O(1): both KV caches are allocated once and "rolled
-  back" by rewinding ``length`` — stale slots above it are masked out of
+  proposals, one (k+1)-token target chunk, prefix-match acceptance as a
+  cumprod. N-gram search is a static window-stack comparison.
+* Cache rollback is O(1): KV caches are allocated once and "rolled back"
+  by rewinding ``length`` — stale slots above it are masked out of
   attention and overwritten by the next round's writes.
 
 Batch 1 only (per-row acceptance counts would need per-row cache
@@ -30,7 +43,7 @@ the serving stack models/decode.py established.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -49,28 +62,11 @@ class SpecStats(NamedTuple):
     accepted: jax.Array
 
 
-def speculative_generate(
-    params: dict,
-    draft_params: dict,
-    prompt: jax.Array,
-    cfg: ModelConfig,
-    draft_cfg: ModelConfig,
-    max_new_tokens: int,
-    *,
-    draft_k: int = 4,
-) -> tuple[jax.Array, SpecStats]:
-    """prompt (1, prompt_len) int32 → ((1, max_new_tokens) int32, stats).
-
-    Greedy speculative decoding; the emitted tokens are exactly
-    ``generate(params, prompt, cfg, max_new_tokens)`` (temperature 0).
-    Jittable end to end with static cfg/max_new_tokens/draft_k.
-    """
+def _check_target(cfg: ModelConfig, prompt: jax.Array) -> None:
     if prompt.shape[0] != 1:
         raise ValueError(
             f"speculative decoding is batch-1 only, got batch {prompt.shape[0]}"
         )
-    if draft_k < 1:
-        raise ValueError(f"draft_k must be >= 1, got {draft_k}")
     from tpu_kubernetes.models.moe import MoEConfig
 
     if isinstance(cfg, MoEConfig):
@@ -82,48 +78,35 @@ def speculative_generate(
             "speculative verification requires a dense target model "
             "(MoE capacity semantics are chunk-size-dependent)"
         )
+
+
+def _spec_loop(
+    params: dict,
+    prompt: jax.Array,
+    cfg: ModelConfig,
+    max_new_tokens: int,
+    k: int,
+    span: int,
+    propose: Callable,   # (last, out, cursor, state) → ((k,) drafts, state)
+    rewind: Callable,    # (state, valid_len) → state
+    state0: Any,
+) -> tuple[jax.Array, SpecStats]:
+    """The shared verify/accept/rollback skeleton. Invariant at every
+    round boundary: the target cache (and any draft cache inside
+    ``state``) holds positions < plen + cursor - 1 — everything before
+    ``last``, which sits at position plen + cursor - 1."""
     plen = prompt.shape[1]
-    # chunk writes can transiently reach plen + max_new - 1 + draft_k
-    span = plen + max_new_tokens + draft_k
-    for name, c in (("target", cfg), ("draft", draft_cfg)):
-        if span > c.max_seq:
-            raise ValueError(
-                f"prompt {plen} + new {max_new_tokens} + draft_k {draft_k} "
-                f"exceeds {name} max_seq {c.max_seq}"
-            )
-
     logits, cache_t = prefill(params, prompt, cfg, max_seq=span)
-    _, cache_d = prefill(draft_params, prompt, draft_cfg, max_seq=span)
     first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]    # ()
-
     out = jnp.zeros((max_new_tokens,), jnp.int32).at[0].set(first)
-    k = draft_k
 
     def cond(carry):
         _, cursor, *_ = carry
         return cursor < max_new_tokens
 
     def body(carry):
-        out, cursor, last, cache_t, cache_d, stats = carry
-
-        # invariant: both caches hold positions < plen + cursor - 1 + 1
-        # == everything before `last`; `last` sits at plen + cursor - 1
-        def dstep(c, _):
-            cache_d, tok = c
-            lg, cache_d = decode_step(
-                draft_params, cache_d, tok[None], draft_cfg
-            )
-            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)[0]
-            return (cache_d, nxt), nxt
-
-        (cache_d, _), drafts = jax.lax.scan(
-            dstep, (cache_d, last), None, length=k
-        )                                                        # (k,)
-        # the draft never processed its own last proposal — one step so
-        # the full-acceptance case finds d_k's K/V in the cache next round
-        _, cache_d = decode_step(
-            draft_params, cache_d, drafts[k - 1][None], draft_cfg
-        )
+        out, cursor, last, cache_t, state, stats = carry
+        drafts, state = propose(last, out, cursor, state)        # (k,)
 
         chunk = jnp.concatenate([last[None], drafts])            # (k+1,)
         logits_c, cache_t = decode_chunk(params, cache_t, chunk[None], cfg)
@@ -142,23 +125,153 @@ def speculative_generate(
         last = greedy[n_emit - 1]
         cursor = cursor + n_emit
 
-        # rewind both caches to "everything before the new last token";
-        # stale higher slots are masked out and overwritten next round
+        # rewind to "everything before the new last token"; stale higher
+        # slots are masked out and overwritten next round
         valid = plen + cursor - 1
         cache_t = cache_t._replace(length=valid)
-        cache_d = cache_d._replace(length=valid)
+        state = rewind(state, valid)
         stats = SpecStats(
             rounds=stats.rounds + 1,
             drafted=stats.drafted + k,
             accepted=stats.accepted + j,
         )
-        return out, cursor, last, cache_t, cache_d, stats
+        return out, cursor, last, cache_t, state, stats
 
     zero = jnp.zeros((), jnp.int32)
     stats0 = SpecStats(rounds=zero, drafted=zero, accepted=zero)
     out, _, _, _, _, stats = jax.lax.while_loop(
         cond,
         body,
-        (out, jnp.asarray(1, jnp.int32), first, cache_t, cache_d, stats0),
+        (out, jnp.asarray(1, jnp.int32), first, cache_t, state0, stats0),
     )
     return out[None, :], stats
+
+
+def speculative_generate(
+    params: dict,
+    draft_params: dict,
+    prompt: jax.Array,
+    cfg: ModelConfig,
+    draft_cfg: ModelConfig,
+    max_new_tokens: int,
+    *,
+    draft_k: int = 4,
+) -> tuple[jax.Array, SpecStats]:
+    """prompt (1, prompt_len) int32 → ((1, max_new_tokens) int32, stats).
+
+    Draft-model speculative decoding; the emitted tokens are exactly
+    ``generate(params, prompt, cfg, max_new_tokens)`` (temperature 0).
+    Jittable end to end with static cfg/max_new_tokens/draft_k.
+    """
+    _check_target(cfg, prompt)
+    if draft_k < 1:
+        raise ValueError(f"draft_k must be >= 1, got {draft_k}")
+    plen = prompt.shape[1]
+    # chunk writes can transiently reach plen + max_new - 1 + draft_k
+    span = plen + max_new_tokens + draft_k
+    for name, c in (("target", cfg), ("draft", draft_cfg)):
+        if span > c.max_seq:
+            raise ValueError(
+                f"prompt {plen} + new {max_new_tokens} + draft_k {draft_k} "
+                f"exceeds {name} max_seq {c.max_seq}"
+            )
+
+    _, cache_d0 = prefill(draft_params, prompt, draft_cfg, max_seq=span)
+    k = draft_k
+
+    def propose(last, out, cursor, cache_d):
+        def dstep(c, _):
+            cache_d, tok = c
+            lg, cache_d = decode_step(
+                draft_params, cache_d, tok[None], draft_cfg
+            )
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)[0]
+            return (cache_d, nxt), nxt
+
+        (cache_d, _), drafts = jax.lax.scan(
+            dstep, (cache_d, last), None, length=k
+        )                                                        # (k,)
+        # the draft never processed its own last proposal — one step so
+        # the full-acceptance case finds d_k's K/V in the cache next round
+        _, cache_d = decode_step(
+            draft_params, cache_d, drafts[k - 1][None], draft_cfg
+        )
+        return drafts, cache_d
+
+    return _spec_loop(
+        params, prompt, cfg, max_new_tokens, k, span,
+        propose, lambda cache_d, valid: cache_d._replace(length=valid),
+        cache_d0,
+    )
+
+
+def prompt_lookup_generate(
+    params: dict,
+    prompt: jax.Array,
+    cfg: ModelConfig,
+    max_new_tokens: int,
+    *,
+    draft_k: int = 8,
+    ngram: int = 2,
+) -> tuple[jax.Array, SpecStats]:
+    """Draft-model-FREE speculative decoding (prompt-lookup): propose the
+    continuation of the most recent earlier occurrence of the last
+    ``ngram`` tokens in the seen context (prompt + generated so far).
+    Proposals cost no model forward, so ``draft_k`` defaults higher than
+    the draft-model path. Output is exactly the target's greedy decode;
+    when no n-gram repeats, each round still emits the target's one
+    correction token (plain decode pace, paid as one chunk pass).
+    """
+    _check_target(cfg, prompt)
+    if draft_k < 1 or ngram < 1:
+        raise ValueError(f"draft_k ({draft_k}) and ngram ({ngram}) must be >= 1")
+    plen = prompt.shape[1]
+    # span doubles as the context-buffer length: [prompt | out | k zeros],
+    # the zero tail making the continuation slice safe near the valid end
+    span = plen + max_new_tokens + draft_k
+    if span > cfg.max_seq:
+        raise ValueError(
+            f"prompt {plen} + new {max_new_tokens} + draft_k {draft_k} "
+            f"exceeds max_seq {cfg.max_seq}"
+        )
+    if ngram > span - 1:
+        raise ValueError(
+            f"ngram ({ngram}) exceeds the context length ({span})"
+        )
+    prompt_vec = prompt[0].astype(jnp.int32)
+
+    def propose(last, out, cursor, state):
+        ctx = jnp.concatenate(
+            [prompt_vec, out, jnp.zeros((draft_k,), jnp.int32)]
+        )
+        valid = plen + cursor                       # tokens seen so far
+        drafts = _ngram_propose(ctx, valid, ngram, draft_k, last)
+        return drafts, state
+
+    return _spec_loop(
+        params, prompt, cfg, max_new_tokens, draft_k, span,
+        propose, lambda state, valid: state, jnp.zeros((), jnp.int32),
+    )
+
+
+def _ngram_propose(ctx: jax.Array, valid, n: int, k: int, last) -> jax.Array:
+    """The prompt-lookup matcher, standalone for direct unit testing:
+    find the LATEST occurrence of ``ctx[valid-n : valid]`` (the tail
+    n-gram) strictly before the tail itself within ``ctx[:valid]``, and
+    return the k tokens following it. No match → ``last`` repeated k
+    times (verification rejects at worst; one correction token still
+    comes out of the round)."""
+    n_windows = ctx.shape[0] - n + 1
+    tail = jax.lax.dynamic_slice(ctx, (valid - n,), (n,))
+    # windows[i] = ctx[i : i+n], as n static shifted slices
+    windows = jnp.stack(
+        [ctx[j : j + n_windows] for j in range(n)], axis=1
+    )                                               # (n_windows, n)
+    pos = jnp.arange(n_windows, dtype=jnp.int32)
+    # match strictly BEFORE the tail itself, fully inside the seen ctx
+    match = jnp.all(windows == tail[None, :], axis=1) & (pos < valid - n)
+    any_match = jnp.any(match)
+    latest = jnp.max(jnp.where(match, pos, -1))
+    start = jnp.maximum(latest + n, 0)
+    drafts = jax.lax.dynamic_slice(ctx, (start,), (k,))
+    return jnp.where(any_match, drafts, jnp.full((k,), last))

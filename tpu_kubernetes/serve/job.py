@@ -37,7 +37,13 @@ whole pipeline is env-driven like the trainer:
                        (SERVE_TEMPERATURE must stay 0), batch-1 per
                        prompt (one retrace per distinct prompt length),
                        single-device (SERVE_MESH ignored); output is
-                       token-identical to the non-draft greedy path.
+                       token-identical to the non-draft greedy path
+                       (up to float ties — models/speculative.py).
+  SERVE_PROMPT_LOOKUP  =1: speculative decoding WITHOUT a draft model —
+                       n-gram (SERVE_NGRAM, default 2) matches in the
+                       seen context propose continuations
+                       (SERVE_DRAFT_K defaults to 8 here). Exclusive
+                       with SERVE_DRAFT_*; same greedy/batch-1 rules.
 
 The reference provisioner has no inference plane (SURVEY §0); this
 completes the in-tree stack's serving story end to end (provision →
@@ -165,24 +171,33 @@ def run_serving(env: dict | None = None) -> list[str]:
     n_tokens = 0
     draft_hf = env.get("SERVE_DRAFT_HF_CHECKPOINT", "")
     draft_name = env.get("SERVE_DRAFT_MODEL", "")
-    if draft_hf or draft_name:
+    lookup = env.get("SERVE_PROMPT_LOOKUP", "").strip().lower() not in (
+        "", "0", "false", "no", "off",
+    )
+    if draft_hf or draft_name or lookup:
         # --- speculative decoding: batch-1, greedy, single-device ------
         # cheap config rejections first — before any checkpoint I/O
         if float(env.get("SERVE_TEMPERATURE", "0")) != 0.0:
             raise SystemExit(
                 "speculative decoding is greedy: unset SERVE_TEMPERATURE "
-                "or drop the SERVE_DRAFT_* config"
+                "or drop the SERVE_DRAFT_*/SERVE_PROMPT_LOOKUP config"
             )
         import functools
 
-        from tpu_kubernetes.models import MoEConfig, speculative_generate
+        from tpu_kubernetes.models import MoEConfig
 
         if isinstance(cfg, MoEConfig):
             raise SystemExit(
                 "speculative decoding needs a dense TARGET model (MoE "
                 "chunk verification is not token-exact); MoE drafts are fine"
             )
-        draft_k = int(env.get("SERVE_DRAFT_K", "4"))
+        draft_k = int(env.get("SERVE_DRAFT_K", "8" if lookup else "4"))
+        ngram = int(env.get("SERVE_NGRAM", "2"))
+        if draft_k < 1 or ngram < 1:
+            raise SystemExit(
+                f"SERVE_DRAFT_K ({draft_k}) and SERVE_NGRAM ({ngram}) "
+                "must be >= 1"
+            )
         if width + max_new + draft_k > cfg.max_seq:
             raise SystemExit(
                 f"longest prompt ({width}) + SERVE_MAX_NEW ({max_new}) "
@@ -190,48 +205,74 @@ def run_serving(env: dict | None = None) -> list[str]:
                 f"model's max_seq {cfg.max_seq}"
             )
 
-        if draft_hf:
-            from tpu_kubernetes.models import load_hf
+        if lookup and (draft_hf or draft_name):
+            raise SystemExit(
+                "SERVE_PROMPT_LOOKUP and SERVE_DRAFT_* are exclusive — "
+                "pick one drafting strategy"
+            )
+        if lookup:
+            from tpu_kubernetes.models import prompt_lookup_generate
 
-            draft_params, draft_cfg = load_hf(draft_hf)
-            log(f"draft: HF checkpoint {draft_hf}")
+            log(f"draft: prompt-lookup (ngram={ngram}, no draft model)")
+            spec = jax.jit(functools.partial(
+                prompt_lookup_generate, cfg=cfg,
+                max_new_tokens=max_new, draft_k=draft_k, ngram=ngram,
+            ))
+
+            def run_one(row):
+                return spec(params, jnp.asarray([row], jnp.int32))
         else:
-            draft_cfg = CONFIGS[draft_name]
-            draft_params = init_params(jax.random.PRNGKey(1), draft_cfg)
-            log(f"draft: random-init {draft_name} (smoke mode)")
-        if draft_cfg.vocab_size != cfg.vocab_size:
-            raise SystemExit(
-                f"draft vocab {draft_cfg.vocab_size} != target vocab "
-                f"{cfg.vocab_size} — the models must share a tokenizer"
-            )
-        if width + max_new + draft_k > draft_cfg.max_seq:
-            raise SystemExit(
-                f"longest prompt ({width}) + SERVE_MAX_NEW ({max_new}) "
-                f"+ SERVE_DRAFT_K ({draft_k}) exceeds the draft "
-                f"model's max_seq {draft_cfg.max_seq}"
-            )
+            from tpu_kubernetes.models import speculative_generate
+
+            if draft_hf:
+                from tpu_kubernetes.models import load_hf
+
+                draft_params, draft_cfg = load_hf(draft_hf)
+                log(f"draft: HF checkpoint {draft_hf}")
+            else:
+                draft_cfg = CONFIGS[draft_name]
+                draft_params = init_params(jax.random.PRNGKey(1), draft_cfg)
+                log(f"draft: random-init {draft_name} (smoke mode)")
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise SystemExit(
+                    f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size} — the models must share a tokenizer"
+                )
+            if width + max_new + draft_k > draft_cfg.max_seq:
+                raise SystemExit(
+                    f"longest prompt ({width}) + SERVE_MAX_NEW ({max_new}) "
+                    f"+ SERVE_DRAFT_K ({draft_k}) exceeds the draft "
+                    f"model's max_seq {draft_cfg.max_seq}"
+                )
+            spec = jax.jit(functools.partial(
+                speculative_generate, cfg=cfg, draft_cfg=draft_cfg,
+                max_new_tokens=max_new, draft_k=draft_k,
+            ))
+
+            def run_one(row):
+                return spec(params, draft_params, jnp.asarray([row], jnp.int32))
+
         t0 = time.perf_counter()
-        spec = jax.jit(functools.partial(
-            speculative_generate, cfg=cfg, draft_cfg=draft_cfg,
-            max_new_tokens=max_new, draft_k=draft_k,
-        ))
         drafted = accepted = 0
         for row in token_rows:
-            out, stats = spec(
-                params, draft_params, jnp.asarray([row], jnp.int32)
-            )
+            out, stats = run_one(row)
             drafted += int(stats.drafted)
             accepted += int(stats.accepted)
             finish(np.asarray(out)[0].tolist())
         log(f"speculative: k={draft_k}, accepted {accepted}/{drafted} "
             f"({accepted / max(1, drafted):.0%})")
     else:
+        # SERVE_CACHE_SPAN: optional KV-cache span override — cache size
+        # changes XLA's attention reduction order, so pinning it makes
+        # runs bitwise-comparable across pipelines (models/decode.generate)
+        span_env = env.get("SERVE_CACHE_SPAN", "")
         fn, p_sh, b_sh = make_sharded_generate(
             cfg, mesh, params, max_new_tokens=max_new,
             temperature=float(env.get("SERVE_TEMPERATURE", "0")),
             top_k=int(env.get("SERVE_TOP_K", "0")),
             top_p=float(env.get("SERVE_TOP_P", "0")),
             eos_id=eos_id, pad_id=pad_id,
+            cache_span=int(span_env) if span_env else None,
         )
         params = jax.device_put(params, p_sh)
         rng = jax.random.PRNGKey(int(env.get("SERVE_SEED", "0")))
