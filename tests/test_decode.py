@@ -16,6 +16,7 @@ from tpu_kubernetes.models import (
     generate,
     init_params,
     prefill,
+    prefill_chunked,
 )
 
 CFG = replace(CONFIGS["llama-test"], dtype=jnp.float32)
@@ -185,3 +186,49 @@ def test_eos_stops_a_finished_row(params):
     assert all(t == -1 for t in row[k + 1:])
     # tokens before the stop are unchanged
     assert row[:k + 1] == np.asarray(free[0, :k + 1]).tolist()
+
+
+def test_chunked_prefill_matches_prefill(params):
+    """prefill_chunked == prefill: same cache contents and (within float
+    reduction-order tolerance) the same last-position logits; a decode
+    continuation from either cache produces the same greedy tokens."""
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(4), (2, 12), 0, CFG.vocab_size
+    )
+    ref_logits, ref_cache = prefill(params, tokens, CFG, max_seq=20)
+    ch_logits, ch_cache = prefill_chunked(
+        params, tokens, CFG, max_seq=20, chunk=4
+    )
+    np.testing.assert_allclose(
+        np.asarray(ch_logits), np.asarray(ref_logits), atol=1e-4, rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(ch_cache.k), np.asarray(ref_cache.k), atol=1e-4, rtol=1e-4
+    )
+    assert int(ch_cache.length) == int(ref_cache.length) == 12
+    # continuations agree
+    tok_r = jnp.argmax(ref_logits, -1).astype(jnp.int32)
+    tok_c = jnp.argmax(ch_logits, -1).astype(jnp.int32)
+    np.testing.assert_array_equal(np.asarray(tok_r), np.asarray(tok_c))
+    lr, _ = decode_step(params, ref_cache, tok_r, CFG)
+    lc, _ = decode_step(params, ch_cache, tok_c, CFG)
+    np.testing.assert_allclose(
+        np.asarray(lc), np.asarray(lr), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_chunked_prefill_rejects_indivisible(params):
+    tokens = jnp.zeros((1, 10), jnp.int32)
+    with pytest.raises(ValueError, match="divide"):
+        prefill_chunked(params, tokens, CFG, max_seq=16, chunk=4)
+
+
+def test_chunked_prefill_rejects_overflow(params):
+    """Oversized prompts must fail loudly: dynamic_update_slice clamping
+    (cache) and RoPE-table gather clipping (model) both corrupt silently."""
+    tokens = jnp.zeros((1, 16), jnp.int32)
+    with pytest.raises(ValueError, match="cache max_seq"):
+        prefill_chunked(params, tokens, CFG, max_seq=8, chunk=4)
+    long = jnp.zeros((1, CFG.max_seq + 4), jnp.int32)
+    with pytest.raises(ValueError, match="model max_seq"):
+        prefill_chunked(params, long, CFG, max_seq=CFG.max_seq + 4, chunk=4)

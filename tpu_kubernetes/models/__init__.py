@@ -21,6 +21,7 @@ from tpu_kubernetes.models.decode import (  # noqa: F401
     generate,
     init_cache,
     prefill,
+    prefill_chunked,
 )
 from tpu_kubernetes.models.speculative import (  # noqa: F401
     SpecStats,

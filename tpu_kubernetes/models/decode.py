@@ -248,6 +248,47 @@ def prefill(
     return logits, cache
 
 
+def prefill_chunked(
+    params: dict, tokens: jax.Array, cfg: ModelConfig,
+    max_seq: int, chunk: int = 256,
+) -> tuple[jax.Array, KVCache]:
+    """Prefill a (batch, prompt_len) prompt in fixed ``chunk``-token
+    pieces: a ``lax.scan`` over the headless decode-chunk body. Same contract
+    as :func:`prefill` (last-position logits + filled cache), but peak
+    activation memory and the compiled attention shape are bounded by
+    ``chunk`` instead of the full prompt — the long-context serving
+    entry. prompt_len must divide by ``chunk`` (right-size or pad-free
+    slice upstream; ragged batches unsupported). The O(vocab) head runs
+    ONCE on the final position, not per chunk. Logits match
+    :func:`prefill` up to float reduction-order differences (the chunk
+    path scores against the growing cache instead of one fused
+    attention)."""
+    b, plen = tokens.shape
+    if plen % chunk:
+        raise ValueError(f"prompt_len {plen} must divide by chunk {chunk}")
+    if plen > max_seq:
+        # dynamic_update_slice would CLAMP out-of-range chunk writes and
+        # silently corrupt the cache tail — fail like prefill does
+        raise ValueError(f"prompt_len {plen} exceeds cache max_seq {max_seq}")
+    if plen > cfg.max_seq:
+        # positions past the RoPE table would silently clip to its last
+        # rotation (gather semantics) — wrong logits, no error
+        raise ValueError(
+            f"prompt_len {plen} exceeds model max_seq {cfg.max_seq}"
+        )
+    cache = init_cache(cfg, b, max_seq)
+
+    def step(cache, piece):
+        hidden, cache = _decode_chunk_hidden(params, cache, piece, cfg)
+        return cache, hidden[:, -1]
+
+    pieces = tokens.reshape(b, plen // chunk, chunk).swapaxes(0, 1)
+    cache, last_hidden = jax.lax.scan(step, cache, pieces)
+    x = rms_norm(last_hidden[-1], params["final_norm"], cfg.norm_eps)
+    logits = (x @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
+    return logits, cache
+
+
 def decode_step(
     params: dict, cache: KVCache, token: jax.Array, cfg: ModelConfig
 ) -> tuple[jax.Array, KVCache]:
@@ -296,6 +337,18 @@ def decode_chunk(
     decoding (models/speculative.py), where the target model scores k
     draft tokens in one pass instead of k sequential steps. Uniform
     batches only (no ragged prompts)."""
+    x, cache = _decode_chunk_hidden(params, cache, tokens, cfg)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
+    return logits, cache
+
+
+def _decode_chunk_hidden(
+    params: dict, cache: KVCache, tokens: jax.Array, cfg: ModelConfig
+) -> tuple[jax.Array, KVCache]:
+    """decode_chunk minus the head: (b, c) tokens → (final hidden states
+    (b, c, d) pre-norm, advanced cache). Chunked prefill scans this so
+    the O(c·vocab) logits matmul runs once at the end, not per chunk."""
     if cache.prompt_lengths is not None:
         raise ValueError("decode_chunk supports uniform batches only")
     c = tokens.shape[1]
@@ -317,9 +370,7 @@ def decode_chunk(
         (x, cache.k, cache.v),
         (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
     )
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
-    return logits, KVCache(k=k_new, v=v_new, length=pos + c)
+    return x, KVCache(k=k_new, v=v_new, length=pos + c)
 
 
 def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
