@@ -139,6 +139,28 @@ def test_bwd_blocks_refit_as_divisors():
     np.testing.assert_allclose(np.asarray(gr), np.asarray(gr_ref), atol=2e-3, rtol=1e-2)
 
 
+def test_random_group_patterns_sweep():
+    """Randomized splits (including empty groups and extreme skew) — the
+    kernel must match the reference for ANY composition of M."""
+    rng = np.random.default_rng(7)
+    m, k, n, e = 256, 128, 128, 5
+    lhs = _rand(0, (m, k))
+    rhs = _rand(1, (e, k, n))
+    for trial in range(12):
+        cuts = np.sort(rng.integers(0, m + 1, size=e - 1))
+        sizes = np.diff(np.concatenate([[0], cuts, [m]])).astype(np.int32)
+        assert sizes.sum() == m
+        gs = jnp.asarray(sizes)
+        ref = grouped_matmul_reference(lhs, rhs, gs)
+        out = grouped_matmul(
+            lhs, rhs, gs, block_m=64, block_n=128, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4,
+            err_msg=f"trial {trial}: sizes={sizes.tolist()}",
+        )
+
+
 def test_jit_and_changing_sizes():
     """Group sizes are runtime VALUES: one compile serves any split."""
     m, k, n, e = 128, 128, 128, 4
