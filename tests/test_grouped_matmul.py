@@ -148,7 +148,14 @@ def test_random_group_patterns_sweep():
     rhs = _rand(1, (e, k, n))
     for trial in range(12):
         cuts = np.sort(rng.integers(0, m + 1, size=e - 1))
+        if trial % 3 == 0:
+            # force empty groups: a duplicated cut (and endpoint cuts on
+            # trial 0) makes at least one np.diff gap exactly zero
+            cuts[0] = 0 if trial == 0 else cuts[1]
+            cuts.sort()
         sizes = np.diff(np.concatenate([[0], cuts, [m]])).astype(np.int32)
+        if trial % 3 == 0:
+            assert (sizes == 0).any(), "empty-group trial produced none"
         assert sizes.sum() == m
         gs = jnp.asarray(sizes)
         ref = grouped_matmul_reference(lhs, rhs, gs)
