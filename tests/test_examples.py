@@ -198,3 +198,25 @@ def test_serving_job_manifest_consistent():
     for name in env:
         if name.startswith("SERVE_"):
             assert name in doc, f"{name} not documented in serve/job.py"
+
+
+def test_speculative_serve_example_contract():
+    """The latency example drives the serve entrypoint with speculative
+    knobs the entrypoint documents; its draft checkpoint differs from
+    the target (that is the point of a draft)."""
+    import yaml
+
+    with open("examples/jobs/serve-speculative-v5e1.yaml") as f:
+        job = yaml.safe_load(f)
+    container = job["spec"]["template"]["spec"]["containers"][0]
+    assert "tpu_kubernetes.serve.job" in container["args"][-1]
+    env = {e["name"]: e.get("value") for e in container["env"]}
+    assert env["SERVE_DRAFT_HF_CHECKPOINT"] != env["SERVE_HF_CHECKPOINT"]
+    assert int(env["SERVE_DRAFT_K"]) >= 1
+
+    import tpu_kubernetes.serve.job as serve_job
+
+    doc = serve_job.__doc__
+    for name in env:
+        if name.startswith("SERVE_"):
+            assert name in doc, f"{name} not documented in serve/job.py"
