@@ -31,6 +31,7 @@ BENCH_STEPS, BENCH_WARMUP, BENCH_MOE_MODEL (default moe-1b; empty skips),
 BENCH_MOE_BATCH (default BENCH_BATCH),
 BENCH_DECODE_BATCH/PROMPT/NEW (empty BENCH_DECODE_NEW skips decode),
 BENCH_DECODE_INT8 (default on; empty skips the int8-export timing),
+BENCH_DECODE_KV (=1 adds the int8-KV-cache timing; off by default),
 BENCH_PROBE_TRIES (default 4 — each try is a ≤150 s subprocess probe).
 """
 
@@ -361,16 +362,18 @@ def measure_train(model_name: str, batch: int, seq: int, steps: int,
 
 
 def decode_roofline_seconds(cfg, param_bytes: int, batch: int,
-                            cache_len_avg: float, bw: float | None) -> float | None:
+                            cache_len_avg: float, bw: float | None,
+                            kv_bytes: float = 2.0) -> float | None:
     """HBM floor for one decode step: stream all weights once + read the
     live K/V cache (GQA: kv heads only) + write one position. Activations
     and the f32 logits are ignored (small next to weights at these
     shapes), so this is a strict lower bound. ``param_bytes`` is the real
-    stored size (bf16, or int8+scales for a quantized export)."""
+    stored size (bf16, or int8+scales for a quantized export);
+    ``kv_bytes`` is bytes per cache element (2 bf16; 1 + 4/head_dim for
+    the int8 cache with its per-row f32 scales)."""
     if not bw:
         return None
-    dtype_bytes = 2  # bf16 cache
-    kv_row = cfg.n_kv_heads * cfg.head_dim * dtype_bytes
+    kv_row = cfg.n_kv_heads * cfg.head_dim * kv_bytes
     cache_read = 2 * cfg.n_layers * batch * kv_row * cache_len_avg  # k and v
     cache_write = 2 * cfg.n_layers * batch * kv_row
     return (param_bytes + cache_read + cache_write) / bw
@@ -395,10 +398,12 @@ def measure_decode(model_name: str, batch: int, prompt_len: int,
     cfg = CONFIGS[model_name]
     reps = 3
 
-    def time_variant(params, label: str) -> tuple[float, float]:
+    def time_variant(params, label: str,
+                     kv_quant: bool = False) -> tuple[float, float]:
         """→ (per_call_s, prefill_s) for one param pytree."""
         gen = jax.jit(lambda p, t: generate(
-            p, t, cfg, max_new_tokens=max_new, temperature=0.0
+            p, t, cfg, max_new_tokens=max_new, temperature=0.0,
+            kv_quant=kv_quant,
         ))
         t0 = time.perf_counter()
         out = gen(params, prompt)
@@ -413,9 +418,11 @@ def measure_decode(model_name: str, batch: int, prompt_len: int,
         per_call = max(1e-9, time.perf_counter() - t0 - rtt) / reps
 
         # time prefill alone so the decode-step figures don't amortize the
-        # prompt pass into "tokens/s" (same cache shape as inside generate)
+        # prompt pass into "tokens/s" (same cache shape AND cache dtype
+        # as inside generate — a bf16 prefill subtracted from a kv-quant
+        # end-to-end would absorb the quantization cost into "decode")
         pf = jax.jit(lambda p, t: prefill(
-            p, t, cfg, max_seq=prompt_len + max_new
+            p, t, cfg, max_seq=prompt_len + max_new, kv_quant=kv_quant
         )[0])
         _sync(pf(params, prompt))  # compile
         t0 = time.perf_counter()
@@ -426,7 +433,7 @@ def measure_decode(model_name: str, batch: int, prompt_len: int,
         return per_call, prefill_time
 
     def variant_result(per_call: float, prefill_time: float,
-                       param_bytes: int) -> dict:
+                       param_bytes: int, kv_bytes: float = 2.0) -> dict:
         decode_time = per_call - prefill_time
         if decode_time <= 0.1 * per_call:
             # prefill dominates (tiny max_new or timing noise): a
@@ -440,7 +447,8 @@ def measure_decode(model_name: str, batch: int, prompt_len: int,
         per_token_ms = decode_time / max_new * 1e3
         # cache length averaged over the decode steps (prompt → prompt+new)
         roofline_s = decode_roofline_seconds(
-            cfg, param_bytes, batch, prompt_len + max_new / 2, bw
+            cfg, param_bytes, batch, prompt_len + max_new / 2, bw,
+            kv_bytes=kv_bytes,
         )
         out = {
             "tokens_per_sec": round(tokens_per_sec, 1),
@@ -490,6 +498,25 @@ def measure_decode(model_name: str, batch: int, prompt_len: int,
                 log(f"decode-int8 failed: {e}")
                 int8_result = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+        kv_result = None
+        if os.environ.get("BENCH_DECODE_KV", ""):
+            # int8 KV cache (off by default: one more compile on a slow
+            # tunneled chip) — halves cache-read bytes; at short bench
+            # contexts the roofline barely moves (params dominate), the
+            # interesting regime is long-context serving
+            try:
+                kv_call, kv_prefill = time_variant(
+                    params, "decode-kvint8", kv_quant=True
+                )
+                kv_result = variant_result(
+                    kv_call, kv_prefill, quantized_param_bytes(params),
+                    kv_bytes=1.0 + 4.0 / cfg.head_dim,
+                )
+                log_variant("decode-kvint8", kv_result)
+            except Exception as e:  # noqa: BLE001 — extra stays in-band
+                log(f"decode-kvint8 failed: {e}")
+                kv_result = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     out = {
         "model": model_name,
         **bf16_result,
@@ -500,6 +527,8 @@ def measure_decode(model_name: str, batch: int, prompt_len: int,
     log_variant("decode", out)
     if int8_result is not None:
         out["int8"] = int8_result
+    if kv_result is not None:
+        out["kv_int8"] = kv_result
     return out
 
 

@@ -232,3 +232,78 @@ def test_chunked_prefill_rejects_overflow(params):
     long = jnp.zeros((1, CFG.max_seq + 4), jnp.int32)
     with pytest.raises(ValueError, match="model max_seq"):
         prefill_chunked(params, long, CFG, max_seq=CFG.max_seq + 4, chunk=4)
+
+
+class TestKVQuant:
+    """Int8 KV cache (kv_quant=True): half the cache bytes per decode
+    step at a small bounded attention rounding error."""
+
+    def test_prefill_identical_decode_close(self, params):
+        """Prefill attention is full-precision (only the STORED cache is
+        quantized), so prefill logits are bit-identical; decode logits
+        drift only by the bounded int8 rounding."""
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(8), (2, 12), 0, CFG.vocab_size
+        )
+        lo_e, c_e = prefill(params, tokens, CFG, max_seq=20)
+        lo_q, c_q = prefill(params, tokens, CFG, max_seq=20, kv_quant=True)
+        np.testing.assert_array_equal(np.asarray(lo_e), np.asarray(lo_q))
+        tok = jnp.argmax(lo_e, -1).astype(jnp.int32)
+        for _ in range(4):
+            le, c_e = decode_step(params, c_e, tok, CFG)
+            lq, c_q = decode_step(params, c_q, tok, CFG)
+            np.testing.assert_allclose(
+                np.asarray(lq), np.asarray(le), atol=0.08, rtol=0.05
+            )
+            tok = jnp.argmax(le, -1).astype(jnp.int32)
+
+    def test_cache_is_int8_and_half_the_bytes(self, params):
+        tokens = jnp.zeros((2, 8), jnp.int32)
+        _, exact = prefill(params, tokens, CFG, max_seq=16)
+        _, quant = prefill(params, tokens, CFG, max_seq=16, kv_quant=True)
+        assert quant.k.dtype == jnp.int8 and quant.v.dtype == jnp.int8
+        assert quant.k_scale.shape == quant.k.shape[:-1]
+        exact_bytes = exact.k.size * exact.k.dtype.itemsize * 2
+        quant_bytes = (
+            quant.k.size * 1 + quant.k_scale.size * 4
+        ) * 2
+        assert quant_bytes < 0.6 * exact_bytes
+
+    def test_generate_and_chunked_prefill_run(self, params):
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(9), (2, 8), 0, CFG.vocab_size
+        )
+        out = generate(params, prompt, CFG, max_new_tokens=5, kv_quant=True)
+        assert out.shape == (2, 5)
+        assert (np.asarray(out) >= 0).all()
+        lo, cache = prefill_chunked(
+            params, prompt, CFG, max_seq=16, chunk=4, kv_quant=True
+        )
+        assert cache.k.dtype == jnp.int8
+        lg, cache = decode_step(params, cache, jnp.argmax(lo, -1).astype(jnp.int32), CFG)
+        assert np.isfinite(np.asarray(lg)).all()
+
+    def test_ragged_rows_match_unpadded_rows(self, params):
+        """Quantization is per (position, head) — padding cannot change a
+        real row's scales, so the ragged identity survives kv_quant."""
+        lengths = [5, 8]
+        plen = max(lengths)
+        rows = [
+            jax.random.randint(
+                jax.random.PRNGKey(50 + i), (1, n), 0, CFG.vocab_size
+            )
+            for i, n in enumerate(lengths)
+        ]
+        padded = jnp.stack([
+            jnp.pad(r[0], (0, plen - r.shape[1])) for r in rows
+        ])
+        got = generate(
+            params, padded, CFG, max_new_tokens=6, kv_quant=True,
+            prompt_lengths=jnp.asarray(lengths, jnp.int32),
+        )
+        for i, r in enumerate(rows):
+            ref = generate(
+                params, r, CFG, max_new_tokens=6, kv_quant=True,
+                cache_span=plen + 6,
+            )
+            np.testing.assert_array_equal(np.asarray(got[i]), np.asarray(ref[0]))

@@ -51,6 +51,14 @@ def test_int8_mode_runs(tmp_path, prompts_file):
     assert len(completions) == 3
 
 
+def test_kv_quant_mode_runs_and_composes_with_int8(tmp_path, prompts_file):
+    completions = run_serving(_env(
+        prompts_file, tmp_path / "out.txt",
+        SERVE_QUANT="int8", SERVE_KV_QUANT="1",
+    ))
+    assert len(completions) == 3
+
+
 def test_speculative_mode_matches_plain_greedy(tmp_path, prompts_file):
     """SERVE_DRAFT_MODEL flips to draft-assisted decoding; completions
     must be token-identical to the plain greedy path (models/speculative's
@@ -94,6 +102,14 @@ def test_lookup_and_draft_exclusive(tmp_path, prompts_file):
         run_serving(_env(
             prompts_file, tmp_path / "o.txt",
             SERVE_PROMPT_LOOKUP="1", SERVE_DRAFT_MODEL="llama-test",
+        ))
+
+
+def test_kv_quant_rejected_in_speculative_modes(tmp_path, prompts_file):
+    with pytest.raises(SystemExit, match="SERVE_KV_QUANT"):
+        run_serving(_env(
+            prompts_file, tmp_path / "o.txt",
+            SERVE_PROMPT_LOOKUP="1", SERVE_KV_QUANT="1",
         ))
 
 

@@ -74,16 +74,48 @@ class KVCache(NamedTuple):
     length: jax.Array  # () int32
     prompt_lengths: jax.Array | None = None  # (batch,) int32
     prompt_slots: jax.Array | None = None    # () int32
+    # int8 KV quantization (kv_quant=True): k/v hold int8 and these hold
+    # the symmetric per-(layer, row, head, position) f32 dequant scales
+    # (L, b, kv, S). None = full-precision cache. Halves the cache bytes
+    # HBM streams per decode step (+4/head_dim scale overhead) and
+    # doubles the max context per HBM byte; attention dequantizes on
+    # read, where XLA fuses the multiply into the score einsum.
+    k_scale: jax.Array | None = None
+    v_scale: jax.Array | None = None
 
 
-def init_cache(cfg: ModelConfig, batch: int, max_seq: int | None = None) -> KVCache:
+def init_cache(
+    cfg: ModelConfig, batch: int, max_seq: int | None = None,
+    kv_quant: bool = False,
+) -> KVCache:
     s = max_seq or cfg.max_seq
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, s, cfg.head_dim)
+    if kv_quant:
+        return KVCache(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            length=jnp.zeros((), jnp.int32),
+            k_scale=jnp.ones(shape[:-1], jnp.float32),
+            v_scale=jnp.ones(shape[:-1], jnp.float32),
+        )
     return KVCache(
         k=jnp.zeros(shape, cfg.dtype),
         v=jnp.zeros(shape, cfg.dtype),
         length=jnp.zeros((), jnp.int32),
     )
+
+
+def _quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(…, head_dim) float → (int8 values, f32 per-row scale (…,)).
+    Symmetric absmax over the head dim — no zero point, so dequant is
+    one broadcast multiply on the attention read path."""
+    x32 = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(
+        jnp.round(x32 / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
 
 
 def _mlp(cfg: ModelConfig, x: jax.Array, layer: dict) -> jax.Array:
@@ -105,7 +137,8 @@ def _mlp(cfg: ModelConfig, x: jax.Array, layer: dict) -> jax.Array:
 
 
 def _attend_cache(cfg, q, k_cache, v_cache, limits,
-                  prompt_lengths=None, prompt_slots=None):
+                  prompt_lengths=None, prompt_slots=None,
+                  k_scale=None, v_scale=None):
     """Decode-side attention only: q (b, h, c, d) against the cache
     (b, kv, S, d); chunk row i attends slots < limits[i] (``limits``
     (c,) shared across the batch, or (b, c) per-row) — causal within a
@@ -118,8 +151,11 @@ def _attend_cache(cfg, q, k_cache, v_cache, limits,
     b, _, c, hd = q.shape
     rep = h // kv
     qg = q.reshape(b, kv, rep, c, hd).astype(jnp.float32)
+    k32 = k_cache.astype(jnp.float32)
+    if k_scale is not None:              # int8 cache → dequantize on read
+        k32 = k32 * k_scale[..., None]
     s = jnp.einsum(
-        "bkrcd,bksd->bkrcs", qg, k_cache.astype(jnp.float32)
+        "bkrcd,bksd->bkrcs", qg, k32
     ) * (1.0 / (cfg.head_dim ** 0.5))
     slots = jnp.arange(k_cache.shape[2])
     mask = slots < limits[..., None]                # (c, S) | (b, c, S)
@@ -135,18 +171,23 @@ def _attend_cache(cfg, q, k_cache, v_cache, limits,
     else:                                           # per-row
         s = jnp.where(mask[:, None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bkrcs,bksd->bkrcd", p, v_cache.astype(jnp.float32))
+    v32 = v_cache.astype(jnp.float32)
+    if v_scale is not None:
+        v32 = v32 * v_scale[..., None]
+    out = jnp.einsum("bkrcs,bksd->bkrcd", p, v32)
     return out.reshape(b, h, c, hd).astype(q.dtype)
 
 
-def _decode_block(cfg, cos, sin, pos, li, x, layer, k_all, v_all,
+def _decode_block(cfg, cos, sin, pos, li, x, layer, kv_state,
                   prompt_lengths=None, prompt_slots=None):
     """One layer, one chunk of c tokens at slots ``pos .. pos+c-1``.
-    x: (b, c, d); the FULL stacked cache (L, b, kv, S, d) is threaded
-    through and layer ``li``'s slice updated in place (one c-position
-    dynamic_update_slice on the scan carry — see module docstring).
-    c == 1 is the classic decode step; c > 1 is chunk verification
-    (ragged prompts are single-token only). → (x, k_all, v_all)."""
+    x: (b, c, d); ``kv_state`` = (k_all, v_all, ks_all, vs_all) — the
+    FULL stacked cache (L, b, kv, S, d) plus the int8 dequant scales (or
+    None, None for a full-precision cache) — threaded through with layer
+    ``li``'s slice updated in place (one c-position dynamic_update_slice
+    on the scan carry — see module docstring). c == 1 is the classic
+    decode step; c > 1 is chunk verification (ragged prompts are
+    single-token only). → (x, kv_state)."""
     b, c, _ = x.shape
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -167,21 +208,37 @@ def _decode_block(cfg, cos, sin, pos, li, x, layer, k_all, v_all,
     q = apply_rope(q, cos, sin, positions=positions)
     k = apply_rope(k, cos, sin, positions=positions)
 
+    k_all, v_all, ks_all, vs_all = kv_state
+    if ks_all is not None:               # int8 cache: quantize on write
+        k, k_sc = _quantize_kv(k)
+        v, v_sc = _quantize_kv(v)
+        ks_all = jax.lax.dynamic_update_slice(
+            ks_all, k_sc[None], (li, 0, 0, pos)
+        )
+        vs_all = jax.lax.dynamic_update_slice(
+            vs_all, v_sc[None], (li, 0, 0, pos)
+        )
     k_all = jax.lax.dynamic_update_slice(k_all, k[None], (li, 0, 0, pos, 0))
     v_all = jax.lax.dynamic_update_slice(v_all, v[None], (li, 0, 0, pos, 0))
     k_cache = jax.lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)
     v_cache = jax.lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)
+    k_scale = v_scale = None
+    if ks_all is not None:
+        k_scale = jax.lax.dynamic_index_in_dim(ks_all, li, 0, keepdims=False)
+        v_scale = jax.lax.dynamic_index_in_dim(vs_all, li, 0, keepdims=False)
 
     attn = _attend_cache(cfg, q, k_cache, v_cache, limits,
-                         prompt_lengths, prompt_slots)
+                         prompt_lengths, prompt_slots,
+                         k_scale=k_scale, v_scale=v_scale)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, c, h * hd)
     x = x + attn @ _w(layer["wo"], cfg.dtype)
-    return _mlp(cfg, x, layer), k_all, v_all
+    return _mlp(cfg, x, layer), (k_all, v_all, ks_all, vs_all)
 
 
 def prefill(
     params: dict, tokens: jax.Array, cfg: ModelConfig,
     max_seq: int | None = None, lengths: jax.Array | None = None,
+    kv_quant: bool = False,
 ) -> tuple[jax.Array, KVCache]:
     """Process the whole prompt at once. tokens: (batch, prompt_len) →
     (last-position logits (batch, vocab) f32, filled cache).
@@ -191,7 +248,11 @@ def prefill(
     prompt_len-1) and the cache records the per-row lengths so decode
     masks the pad slots. Causality already keeps real tokens blind to the
     trailing pads; the garbage K/V the pad positions produce is dealt
-    with at decode time (see KVCache)."""
+    with at decode time (see KVCache).
+
+    ``kv_quant`` stores the cache as int8 + per-position scales (see
+    KVCache): prefill's own attention still runs full-precision — only
+    what later decode steps READ is quantized."""
     b, plen = tokens.shape
     S = max_seq or cfg.max_seq
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
@@ -225,14 +286,34 @@ def prefill(
         )
         attn = attn.transpose(0, 2, 1, 3).reshape(b, plen, h * hd)
         x = x + attn @ _w(layer["wo"], cfg.dtype)
+        if kv_quant:
+            # quantize the plen real positions, THEN pad: identical cache
+            # (pad q=0, scale=1) without absmax/round work over S - plen
+            # all-zero rows
+            kq8, k_sc = _quantize_kv(k)
+            vq8, v_sc = _quantize_kv(v)
+            sc_pad = [(0, 0), (0, 0), (0, S - plen)]
+            return _mlp(cfg, x, layer), (
+                jnp.pad(kq8, pad), jnp.pad(vq8, pad),
+                jnp.pad(k_sc, sc_pad, constant_values=1.0),
+                jnp.pad(v_sc, sc_pad, constant_values=1.0),
+            )
         return _mlp(cfg, x, layer), (k_full, v_full)
 
-    x, (k_cache, v_cache) = jax.lax.scan(block, x, params["layers"])
+    if kv_quant:
+        x, (k_cache, v_cache, k_sc, v_sc) = jax.lax.scan(
+            block, x, params["layers"]
+        )
+        scales = {"k_scale": k_sc, "v_scale": v_sc}
+    else:
+        x, (k_cache, v_cache) = jax.lax.scan(block, x, params["layers"])
+        scales = {}
 
     if lengths is None:
         x_last = x[:, -1]
         cache = KVCache(
-            k=k_cache, v=v_cache, length=jnp.asarray(plen, jnp.int32)
+            k=k_cache, v=v_cache, length=jnp.asarray(plen, jnp.int32),
+            **scales,
         )
     else:
         x_last = jnp.take_along_axis(
@@ -242,6 +323,7 @@ def prefill(
             k=k_cache, v=v_cache, length=jnp.asarray(plen, jnp.int32),
             prompt_lengths=lengths.astype(jnp.int32),
             prompt_slots=jnp.asarray(plen, jnp.int32),
+            **scales,
         )
     x_last = rms_norm(x_last, params["final_norm"], cfg.norm_eps)
     logits = (x_last @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
@@ -250,7 +332,7 @@ def prefill(
 
 def prefill_chunked(
     params: dict, tokens: jax.Array, cfg: ModelConfig,
-    max_seq: int, chunk: int = 256,
+    max_seq: int, chunk: int = 256, kv_quant: bool = False,
 ) -> tuple[jax.Array, KVCache]:
     """Prefill a (batch, prompt_len) prompt in fixed ``chunk``-token
     pieces: a ``lax.scan`` over the headless decode-chunk body. Same contract
@@ -276,7 +358,7 @@ def prefill_chunked(
         raise ValueError(
             f"prompt_len {plen} exceeds model max_seq {cfg.max_seq}"
         )
-    cache = init_cache(cfg, b, max_seq)
+    cache = init_cache(cfg, b, max_seq, kv_quant=kv_quant)
 
     def step(cache, piece):
         hidden, cache = _decode_chunk_hidden(params, cache, piece, cfg)
@@ -299,18 +381,18 @@ def decode_step(
     x = params["embed"][token][:, None, :]                   # (b, 1, d)
 
     def block(carry, xs):
-        x, k_all, v_all = carry
+        x, kv_state = carry
         layer, li = xs
-        x, k_all, v_all = _decode_block(
-            cfg, cos, sin, pos, li, x, layer, k_all, v_all,
+        x, kv_state = _decode_block(
+            cfg, cos, sin, pos, li, x, layer, kv_state,
             cache.prompt_lengths, cache.prompt_slots,
         )
-        return (x, k_all, v_all), None
+        return (x, kv_state), None
 
     n_layers = cache.k.shape[0]
-    (x, k_new, v_new), _ = jax.lax.scan(
+    (x, (k_new, v_new, ks_new, vs_new)), _ = jax.lax.scan(
         block,
-        (x, cache.k, cache.v),
+        (x, (cache.k, cache.v, cache.k_scale, cache.v_scale)),
         (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
     )
 
@@ -319,6 +401,7 @@ def decode_step(
     return logits, KVCache(
         k=k_new, v=v_new, length=pos + 1,
         prompt_lengths=cache.prompt_lengths, prompt_slots=cache.prompt_slots,
+        k_scale=ks_new, v_scale=vs_new,
     )
 
 
@@ -357,20 +440,22 @@ def _decode_chunk_hidden(
     x = params["embed"][tokens]                                 # (b, c, d)
 
     def block(carry, xs):
-        x, k_all, v_all = carry
+        x, kv_state = carry
         layer, li = xs
-        x, k_all, v_all = _decode_block(
-            cfg, cos, sin, pos, li, x, layer, k_all, v_all
+        x, kv_state = _decode_block(
+            cfg, cos, sin, pos, li, x, layer, kv_state
         )
-        return (x, k_all, v_all), None
+        return (x, kv_state), None
 
     n_layers = cache.k.shape[0]
-    (x, k_new, v_new), _ = jax.lax.scan(
+    (x, (k_new, v_new, ks_new, vs_new)), _ = jax.lax.scan(
         block,
-        (x, cache.k, cache.v),
+        (x, (cache.k, cache.v, cache.k_scale, cache.v_scale)),
         (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
     )
-    return x, KVCache(k=k_new, v=v_new, length=pos + c)
+    return x, KVCache(
+        k=k_new, v=v_new, length=pos + c, k_scale=ks_new, v_scale=vs_new
+    )
 
 
 def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
@@ -414,6 +499,7 @@ def generate(
     eos_id: int | None = None,
     pad_id: int = 0,
     cache_span: int | None = None,
+    kv_quant: bool = False,
 ) -> jax.Array:
     """prompt (batch, prompt_len) int32 → (batch, max_new_tokens) int32.
 
@@ -422,6 +508,10 @@ def generate(
     reduction order, which can flip greedy argmax on near-tied logits —
     pass the other program's span when comparing outputs bitwise (e.g.
     speculative decoding allocates prompt + new + draft_k).
+
+    ``kv_quant`` serves from an int8 KV cache (see KVCache) — half the
+    cache bytes per decode step at a small, bounded rounding error in
+    attention (≤ 1/127 of each head-row's absmax per element).
     Jittable end to end (prefill + lax.scan of decode steps with sampling
     folded in); wrap in jax.jit with static cfg/max_new_tokens for a
     single compiled serving program.
@@ -453,7 +543,7 @@ def generate(
     # not cfg.max_seq (static per compile, same as max_new_tokens)
     logits, cache = prefill(
         params, prompt, cfg, max_seq=cache_span or (plen + max_new_tokens),
-        lengths=prompt_lengths,
+        lengths=prompt_lengths, kv_quant=kv_quant,
     )
     first = _sample(logits, first_rng, temperature, top_k, top_p)
     done = jnp.zeros(prompt.shape[0], bool)

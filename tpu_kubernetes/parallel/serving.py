@@ -68,7 +68,7 @@ def make_sharded_generate(
     cfg: ModelConfig, mesh: Mesh, params: dict, *,
     max_new_tokens: int, temperature: float = 0.0, top_k: int = 0,
     top_p: float = 0.0, eos_id: int | None = None, pad_id: int = 0,
-    cache_span: int | None = None,
+    cache_span: int | None = None, kv_quant: bool = False,
 ) -> tuple[Callable, Any, NamedSharding]:
     """→ (generate_fn(params, prompt, rng=None, prompt_lengths=None) ->
     tokens, param shardings, prompt sharding). Mirrors
@@ -90,7 +90,7 @@ def make_sharded_generate(
             params, prompt, cfg, max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
             prompt_lengths=prompt_lengths, eos_id=eos_id, pad_id=pad_id,
-            cache_span=cache_span,
+            cache_span=cache_span, kv_quant=kv_quant,
         )
 
     jitted = jax.jit(

@@ -27,6 +27,11 @@ whole pipeline is env-driven like the trainer:
                        (default: tensor over all local devices)
   SERVE_QUANT          'int8' → weight-only quantized export
                        (models/quant.py); empty = model dtype
+  SERVE_KV_QUANT       =1: int8 KV cache (half the cache bytes per
+                       decode step; bounded attention rounding —
+                       models/decode.KVCache). Composes with SERVE_QUANT;
+                       rejected in speculative/prompt-lookup modes
+                       (exact verification keeps a full-precision cache).
   SERVE_TEMPERATURE / SERVE_TOP_K / SERVE_TOP_P / SERVE_SEED
   SERVE_EOS_ID         stop rows at this token (emitted tokens after it
                        are dropped from the text)
@@ -174,7 +179,18 @@ def run_serving(env: dict | None = None) -> list[str]:
     lookup = env.get("SERVE_PROMPT_LOOKUP", "").strip().lower() not in (
         "", "0", "false", "no", "off",
     )
+    kv_quant = env.get("SERVE_KV_QUANT", "").strip().lower() not in (
+        "", "0", "false", "no", "off",
+    )
     if draft_hf or draft_name or lookup:
+        if kv_quant:
+            # refuse rather than silently drop the knob: the speculative
+            # paths are exactness-first and run their own bf16 caches
+            raise SystemExit(
+                "SERVE_KV_QUANT is not supported in speculative/"
+                "prompt-lookup modes (exact verification uses a "
+                "full-precision cache) — drop one of the knobs"
+            )
         # --- speculative decoding: batch-1, greedy, single-device ------
         # cheap config rejections first — before any checkpoint I/O
         if float(env.get("SERVE_TEMPERATURE", "0")) != 0.0:
@@ -273,6 +289,7 @@ def run_serving(env: dict | None = None) -> list[str]:
             top_p=float(env.get("SERVE_TOP_P", "0")),
             eos_id=eos_id, pad_id=pad_id,
             cache_span=int(span_env) if span_env else None,
+            kv_quant=kv_quant,
         )
         params = jax.device_put(params, p_sh)
         rng = jax.random.PRNGKey(int(env.get("SERVE_SEED", "0")))
