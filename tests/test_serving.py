@@ -58,6 +58,22 @@ def test_quantized_sharded_generate_matches_quantized_single_device():
     assert shard.shape[-1] < s.shape[-1]
 
 
+def test_kv_quant_sharded_generate_matches_single_device():
+    """Int8 KV cache composes with tensor-parallel serving: sharded
+    kv-quant generation is token-identical to single-device kv-quant."""
+    mesh = create_mesh({"data": 2, "tensor": 4})
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (4, 8), 0, CFG.vocab_size
+    )
+    ref = generate(params, prompt, CFG, max_new_tokens=6, kv_quant=True)
+    fn, p_sh, b_sh = make_sharded_generate(
+        CFG, mesh, params, max_new_tokens=6, kv_quant=True
+    )
+    out = fn(jax.device_put(params, p_sh), jax.device_put(prompt, b_sh))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
 def test_moe_expert_parallel_generate_matches_single_device():
     mesh = create_mesh({"expert": 4, "tensor": 2})
     params = init_params(jax.random.PRNGKey(0), MOE_CFG)
