@@ -1,5 +1,12 @@
-"""tpu_kubernetes.serve — the batch-inference entrypoint of the in-tree
-stack (``python -m tpu_kubernetes.serve.job``), the serving analog of
-tpu_kubernetes.train.job."""
+"""tpu_kubernetes.serve — the inference entrypoints of the in-tree
+stack: batch (``python -m tpu_kubernetes.serve.job``, the serving analog
+of tpu_kubernetes.train.job) and live HTTP
+(``python -m tpu_kubernetes.serve.server``, what sits behind a
+Kubernetes Service)."""
 
-from tpu_kubernetes.serve.job import main, run_serving  # noqa: F401
+from tpu_kubernetes.serve.job import (  # noqa: F401
+    load_serving_stack,
+    main,
+    run_serving,
+)
+from tpu_kubernetes.serve.server import make_server  # noqa: F401

@@ -67,6 +67,15 @@ def log(*args) -> None:
     print("[serve]", *args, file=sys.stderr, flush=True)
 
 
+def truthy_env(env: dict, name: str) -> bool:
+    """One falsy-string rule for the whole SERVE_* env contract (shared
+    with serve/server.py — diverging copies would make the batch job and
+    the HTTP server read the same env differently)."""
+    return env.get(name, "").strip().lower() not in (
+        "", "0", "false", "no", "off",
+    )
+
+
 def _detokenizer(spec: str):
     """Inverse of train/corpus.py's tokenizers: ids → text. The byte
     decoder never silently drops ids — run_serving refuses up front when
@@ -83,26 +92,16 @@ def _detokenizer(spec: str):
     raise ValueError(f"unknown tokenizer {spec!r}")
 
 
-def run_serving(env: dict | None = None) -> list[str]:
-    """The whole pipeline; ``env`` defaults to os.environ (injectable for
-    tests). Returns the completions (also written to SERVE_OUT)."""
-    env = dict(os.environ if env is None else env)
-
+def load_serving_stack(env: dict):
+    """The env-driven model/tokenizer bring-up shared by the batch job
+    and the HTTP server (serve/server.py): SERVE_MODEL /
+    SERVE_HF_CHECKPOINT / SERVE_TOKENIZER / SERVE_QUANT →
+    (params, cfg, encode, decode_text)."""
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
     from tpu_kubernetes.models import CONFIGS, init_params
     from tpu_kubernetes.models.quant import quantize_for_decode
-    from tpu_kubernetes.parallel import create_mesh, make_sharded_generate
     from tpu_kubernetes.train.corpus import resolve_tokenizer
-
-    prompts_path = env.get("SERVE_PROMPTS", "")
-    if not prompts_path:
-        raise SystemExit("SERVE_PROMPTS must point at a prompts file")
-    prompts = Path(prompts_path).read_text(encoding="utf-8").splitlines()
-    if not prompts:
-        raise SystemExit(f"{prompts_path} holds no prompts")
 
     tok_spec = env.get("SERVE_TOKENIZER", "byte")
     encode, vocab = resolve_tokenizer(tok_spec)
@@ -136,6 +135,29 @@ def run_serving(env: dict | None = None) -> list[str]:
     if env.get("SERVE_QUANT", "") == "int8":
         params = quantize_for_decode(params, cfg)
         log("int8 weight-only export")
+    return params, cfg, encode, decode_text
+
+
+def run_serving(env: dict | None = None) -> list[str]:
+    """The whole pipeline; ``env`` defaults to os.environ (injectable for
+    tests). Returns the completions (also written to SERVE_OUT)."""
+    env = dict(os.environ if env is None else env)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_kubernetes.models import CONFIGS, init_params
+    from tpu_kubernetes.parallel import create_mesh, make_sharded_generate
+
+    prompts_path = env.get("SERVE_PROMPTS", "")
+    if not prompts_path:
+        raise SystemExit("SERVE_PROMPTS must point at a prompts file")
+    prompts = Path(prompts_path).read_text(encoding="utf-8").splitlines()
+    if not prompts:
+        raise SystemExit(f"{prompts_path} holds no prompts")
+
+    params, cfg, encode, decode_text = load_serving_stack(env)
 
     mesh_spec = env.get("SERVE_MESH", "")
     if mesh_spec:
@@ -176,12 +198,8 @@ def run_serving(env: dict | None = None) -> list[str]:
     n_tokens = 0
     draft_hf = env.get("SERVE_DRAFT_HF_CHECKPOINT", "")
     draft_name = env.get("SERVE_DRAFT_MODEL", "")
-    lookup = env.get("SERVE_PROMPT_LOOKUP", "").strip().lower() not in (
-        "", "0", "false", "no", "off",
-    )
-    kv_quant = env.get("SERVE_KV_QUANT", "").strip().lower() not in (
-        "", "0", "false", "no", "off",
-    )
+    lookup = truthy_env(env, "SERVE_PROMPT_LOOKUP")
+    kv_quant = truthy_env(env, "SERVE_KV_QUANT")
     if draft_hf or draft_name or lookup:
         if kv_quant:
             # refuse rather than silently drop the knob: the speculative

@@ -1,0 +1,232 @@
+"""HTTP inference server: the live-serving analog of the batch job
+(serve/job.py) — what sits behind a Kubernetes Service instead of a Job.
+
+A minimal stdlib server (zero dependencies, air-gap friendly) exposing:
+
+  GET  /healthz            → {"status": "ok", "model": ..., ...}
+                             (readiness probe; returns 503 until the
+                             first compile has finished warming)
+  POST /v1/completions     → {"prompt": str, "max_new_tokens"?: int,
+                              "temperature"?: float, "top_k"?: int,
+                              "top_p"?: float, "seed"?: int}
+                             ⇒ {"text": str, "tokens": int, "model": str}
+
+Model bring-up reuses the batch job's env contract exactly
+(``load_serving_stack``: SERVE_MODEL / SERVE_HF_CHECKPOINT /
+SERVE_TOKENIZER / SERVE_QUANT), plus SERVE_KV_QUANT for the int8 KV
+cache, SERVE_EOS_ID (tokens after it are truncated from responses),
+SERVER_HOST/SERVER_PORT, and SERVE_MAX_NEW as the per-request
+``max_new_tokens`` cap.
+
+TPU-first serving discipline:
+
+* **Bucketed compiles.** Prompts are right-padded to power-of-two widths
+  and served ragged (``prompt_lengths``), so the number of distinct
+  compiled programs is O(log max_seq) per sampling configuration — not
+  one per prompt length. Programs are cached by their static signature
+  (max_new, sampling knobs) in ServingState, one jitted callable each,
+  and jax.jit's shape cache handles the width buckets under it.
+* **One request on the chip at a time.** A lock serializes generation
+  (the chip is the bottleneck; queueing in the server beats queueing in
+  PJRT), while the ThreadingHTTPServer keeps health checks responsive
+  during long generations.
+* Startup warms the default bucket so the readiness probe flips only
+  when real traffic would be served at full speed.
+
+The reference provisioner has no inference plane (SURVEY §0); this
+completes provision → import weights → quantize → serve-over-HTTP.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def log(*args) -> None:
+    print("[server]", *args, file=sys.stderr, flush=True)
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class ServingState:
+    """Model + compiled-program cache + the generation lock."""
+
+    def __init__(self, env: dict):
+        import jax  # deferred: the server module must import without jax
+
+        from tpu_kubernetes.serve.job import load_serving_stack, truthy_env
+
+        self.env = env
+        params, cfg, encode, decode_text = load_serving_stack(env)
+        self.params, self.cfg = params, cfg
+        self.encode, self.decode_text = encode, decode_text
+        self.max_new_cap = int(env.get("SERVE_MAX_NEW", "64"))
+        self.kv_quant = truthy_env(env, "SERVE_KV_QUANT")
+        eos_env = env.get("SERVE_EOS_ID", "")
+        self.eos_id = int(eos_env) if eos_env else None
+        self.model_name = env.get("SERVE_HF_CHECKPOINT", "") or env.get(
+            "SERVE_MODEL", "llama-test"
+        )
+        self._lock = threading.Lock()
+        self._jax = jax
+        # jitted programs keyed by their STATIC arguments — jax.jit's own
+        # cache keys on callable identity, so a fresh partial per request
+        # would re-trace+compile every time
+        self._programs: dict = {}
+        self.ready = False
+
+    def warm(self) -> None:
+        """Compile the program a DEFAULT request uses (the full
+        max_new_tokens cap, greedy, smallest bucket) before going ready,
+        so the readiness flip means real traffic runs at full speed."""
+        self.complete("")
+        self.ready = True
+        log("warm: default program compiled, serving")
+
+    def _program(self, max_new: int, temperature: float, top_k: int,
+                 top_p: float):
+        import functools
+
+        from tpu_kubernetes.models import generate
+
+        key = (max_new, temperature, top_k, top_p)
+        fn = self._programs.get(key)
+        if fn is None:
+            fn = self._jax.jit(functools.partial(
+                generate, cfg=self.cfg, max_new_tokens=max_new,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_id=self.eos_id, kv_quant=self.kv_quant,
+            ))
+            self._programs[key] = fn
+        return fn
+
+    def complete(self, prompt: str, max_new_tokens: int | None = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0, seed: int = 0) -> dict:
+        jax = self._jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        cfg = self.cfg
+        max_new = (
+            self.max_new_cap if max_new_tokens is None
+            else int(max_new_tokens)   # 0 is a VALUE (and rejected), not unset
+        )
+        max_new = min(max_new, self.max_new_cap)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        ids = self.encode(prompt) or [0]      # empty prompt → one pad row
+        width = _bucket(len(ids))
+        if width + max_new > cfg.max_seq:
+            raise ValueError(
+                f"prompt ({len(ids)} tokens, bucket {width}) + "
+                f"max_new_tokens ({max_new}) exceeds max_seq {cfg.max_seq}"
+            )
+        padded = np.zeros((1, width), np.int32)
+        padded[0, :len(ids)] = ids
+
+        fn = self._program(max_new, float(temperature), int(top_k),
+                           float(top_p))
+        with self._lock:
+            out = fn(
+                self.params, jnp.asarray(padded),
+                rng=jax.random.PRNGKey(int(seed)),
+                prompt_lengths=jnp.asarray([len(ids)], jnp.int32),
+            )
+            tokens = np.asarray(out)[0].tolist()
+        if self.eos_id is not None and self.eos_id in tokens:
+            tokens = tokens[:tokens.index(self.eos_id)]
+        return {
+            "text": self.decode_text(tokens),
+            "tokens": len(tokens),
+            "model": self.model_name,
+        }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: ServingState  # set by make_server
+
+    def log_message(self, fmt, *args):  # route through our logger
+        log(self.address_string(), fmt % args)
+
+    def _json(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        if self.path != "/healthz":
+            return self._json(404, {"error": "unknown path"})
+        st = self.state
+        if not st.ready:
+            return self._json(503, {"status": "warming"})
+        return self._json(200, {
+            "status": "ok",
+            "model": st.model_name,
+            "max_new_tokens_cap": st.max_new_cap,
+            "kv_quant": st.kv_quant,
+        })
+
+    def do_POST(self):  # noqa: N802
+        if self.path != "/v1/completions":
+            return self._json(404, {"error": "unknown path"})
+        if not self.state.ready:
+            return self._json(503, {"error": "warming"})
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(body, dict) or "prompt" not in body:
+                raise ValueError('body must be a JSON object with "prompt"')
+            result = self.state.complete(
+                str(body["prompt"]),
+                max_new_tokens=body.get("max_new_tokens"),
+                temperature=body.get("temperature", 0.0),
+                top_k=body.get("top_k", 0),
+                top_p=body.get("top_p", 0.0),
+                seed=body.get("seed", 0),
+            )
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            # TypeError covers wrong-typed JSON fields (e.g. top_k: [1])
+            # — a malformed request must be a 400, not a dropped socket
+            return self._json(400, {"error": str(e)})
+        return self._json(200, result)
+
+
+def make_server(env: dict | None = None) -> ThreadingHTTPServer:
+    """Build (but don't run) the server — tests drive it on an ephemeral
+    port. The model is loaded and warmed before this returns."""
+    env = dict(os.environ if env is None else env)
+    state = ServingState(env)
+    state.warm()
+
+    handler = type("Handler", (_Handler,), {"state": state})
+    host = env.get("SERVER_HOST", "127.0.0.1")
+    port = int(env.get("SERVER_PORT", "8000"))
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def main() -> int:
+    server = make_server()
+    host, port = server.server_address[:2]
+    log(f"listening on {host}:{port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
