@@ -200,6 +200,31 @@ def test_serving_job_manifest_consistent():
             assert name in doc, f"{name} not documented in serve/job.py"
 
 
+def test_http_serve_example_contract():
+    """The Deployment drives the HTTP server with documented knobs, its
+    readiness probe hits the server's health path on the served port,
+    and the Service targets that port."""
+    import yaml
+
+    with open("examples/jobs/serve-http-v5e1.yaml") as f:
+        deployment, service = list(yaml.safe_load_all(f))
+    container = deployment["spec"]["template"]["spec"]["containers"][0]
+    assert "tpu_kubernetes.serve.server" in container["args"][-1]
+    env = {e["name"]: e.get("value") for e in container["env"]}
+
+    import tpu_kubernetes.serve.server as http_server
+
+    doc = http_server.__doc__
+    for name in env:
+        if name.startswith(("SERVE_", "SERVER_")):
+            assert name in doc, f"{name} not documented in serve/server.py"
+
+    probe = container["readinessProbe"]["httpGet"]
+    assert probe["path"] == "/healthz"
+    assert str(probe["port"]) == env["SERVER_PORT"]
+    assert service["spec"]["ports"][0]["targetPort"] == probe["port"]
+
+
 def test_speculative_serve_example_contract():
     """The latency example drives the serve entrypoint with speculative
     knobs the entrypoint documents; its draft checkpoint differs from
