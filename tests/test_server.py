@@ -119,6 +119,54 @@ def test_bad_requests_rejected(server):
     assert status == 400
 
 
+def test_streaming_matches_non_streamed_greedy(server):
+    """stream=true delivers a chunked response whose concatenation is
+    the non-streamed greedy text (same cache span, same math)."""
+    req = {"prompt": "stream me", "max_new_tokens": 6}
+    _, plain = _request(server, "POST", "/v1/completions", req)
+
+    host, port = server.server_address[:2]
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request(
+        "POST", "/v1/completions",
+        body=json.dumps({**req, "stream": True}),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.chunked                      # genuinely streamed
+    text = resp.read().decode("utf-8")
+    conn.close()
+    assert text == plain["text"]
+
+
+def test_streaming_sampled_matches_non_streamed_seed(server):
+    """Same seed, same temperature → identical text whether or not the
+    client streams (the streaming loop mirrors generate's rng schedule)."""
+    req = {"prompt": "seeded", "max_new_tokens": 6, "temperature": 0.9,
+           "seed": 11}
+    _, plain = _request(server, "POST", "/v1/completions", req)
+    host, port = server.server_address[:2]
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request(
+        "POST", "/v1/completions",
+        body=json.dumps({**req, "stream": True}),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    text = resp.read().decode("utf-8")
+    conn.close()
+    assert text == plain["text"]
+
+
+def test_streaming_bad_request_still_400(server):
+    status, data = _request(
+        server, "POST", "/v1/completions",
+        {"prompt": "x", "stream": True, "max_new_tokens": 0},
+    )
+    assert status == 400
+
+
 def test_repeat_request_hits_program_cache(server):
     """Two identical requests must reuse one compiled program (a fresh
     jit per request would recompile inside the generation lock)."""

@@ -8,8 +8,16 @@ A minimal stdlib server (zero dependencies, air-gap friendly) exposing:
                              first compile has finished warming)
   POST /v1/completions     → {"prompt": str, "max_new_tokens"?: int,
                               "temperature"?: float, "top_k"?: int,
-                              "top_p"?: float, "seed"?: int}
+                              "top_p"?: float, "seed"?: int,
+                              "stream"?: bool}
                              ⇒ {"text": str, "tokens": int, "model": str}
+                             — or, with "stream": true, a chunked
+                             text/plain response whose pieces arrive as
+                             tokens decode (a per-token decode_step loop
+                             instead of the fused generate program;
+                             UTF-8-safe: each piece is the delta of the
+                             decoded prefix, so multi-byte characters
+                             never split across chunks)
 
 Model bring-up reuses the batch job's env contract exactly
 (``load_serving_stack``: SERVE_MODEL / SERVE_HF_CHECKPOINT /
@@ -85,12 +93,16 @@ class ServingState:
         self.ready = False
 
     def warm(self) -> None:
-        """Compile the program a DEFAULT request uses (the full
-        max_new_tokens cap, greedy, smallest bucket) before going ready,
-        so the readiness flip means real traffic runs at full speed."""
+        """Compile the programs DEFAULT requests use — the fused
+        generate at the full max_new_tokens cap AND the streaming pair
+        (prefill + decode step), greedy, smallest bucket — before going
+        ready, so the readiness flip means real traffic (either mode)
+        runs at full speed."""
         self.complete("")
+        for _ in self.stream(""):
+            pass
         self.ready = True
-        log("warm: default program compiled, serving")
+        log("warm: default programs (fused + streaming) compiled, serving")
 
     def _program(self, max_new: int, temperature: float, top_k: int,
                  top_p: float):
@@ -109,14 +121,11 @@ class ServingState:
             self._programs[key] = fn
         return fn
 
-    def complete(self, prompt: str, max_new_tokens: int | None = None,
-                 temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 0.0, seed: int = 0) -> dict:
-        jax = self._jax
-        import jax.numpy as jnp
+    def _validate(self, prompt: str, max_new_tokens: int | None):
+        """Shared request validation → (padded (1, width) np.int32,
+        prompt ids, max_new)."""
         import numpy as np
 
-        cfg = self.cfg
         max_new = (
             self.max_new_cap if max_new_tokens is None
             else int(max_new_tokens)   # 0 is a VALUE (and rejected), not unset
@@ -126,13 +135,24 @@ class ServingState:
             raise ValueError("max_new_tokens must be >= 1")
         ids = self.encode(prompt) or [0]      # empty prompt → one pad row
         width = _bucket(len(ids))
-        if width + max_new > cfg.max_seq:
+        if width + max_new > self.cfg.max_seq:
             raise ValueError(
                 f"prompt ({len(ids)} tokens, bucket {width}) + "
-                f"max_new_tokens ({max_new}) exceeds max_seq {cfg.max_seq}"
+                f"max_new_tokens ({max_new}) exceeds max_seq "
+                f"{self.cfg.max_seq}"
             )
         padded = np.zeros((1, width), np.int32)
         padded[0, :len(ids)] = ids
+        return padded, ids, max_new
+
+    def complete(self, prompt: str, max_new_tokens: int | None = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0, seed: int = 0) -> dict:
+        jax = self._jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        padded, ids, max_new = self._validate(prompt, max_new_tokens)
 
         fn = self._program(max_new, float(temperature), int(top_k),
                            float(top_p))
@@ -151,9 +171,96 @@ class ServingState:
             "model": self.model_name,
         }
 
+    def stream(self, prompt: str, max_new_tokens: int | None = None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 0.0, seed: int = 0):
+        """Yield text pieces as tokens decode: prefill once, then a
+        per-token jitted decode_step+sample loop (the fused generate
+        cannot surface tokens before the scan finishes). Each piece is
+        the delta of the decoded prefix, so tokenizers whose characters
+        span tokens never emit split multi-byte sequences."""
+        jax = self._jax
+        import functools
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_kubernetes.models.decode import _sample, decode_step, prefill
+
+        padded, ids, max_new = self._validate(prompt, max_new_tokens)
+        cfg = self.cfg
+        width = padded.shape[1]
+
+        # keyed by the SPAN (the only static the compile depends on):
+        # different (width, max_new) pairs with one span share a program,
+        # keeping the O(log max_seq)-programs discipline
+        span = width + max_new
+        pf_key = ("prefill", span)
+        pf = self._programs.get(pf_key)
+        if pf is None:
+            pf = jax.jit(functools.partial(
+                prefill, cfg=cfg, max_seq=span, kv_quant=self.kv_quant,
+            ))
+            self._programs[pf_key] = pf
+
+        step_key = ("step", float(temperature), int(top_k), float(top_p))
+        step = self._programs.get(step_key)
+        if step is None:
+            def _step(params, cache, tok, rng):
+                logits, cache = decode_step(params, cache, tok, cfg)
+                nxt = _sample(
+                    logits, rng, float(temperature), int(top_k),
+                    float(top_p),
+                )
+                return nxt, cache
+
+            step = jax.jit(_step)
+            self._programs[step_key] = step
+
+        # the SAME rng schedule as generate(): the first token draws from
+        # split(rng)[1], step i from split(rng, max_new-1)[i] — so a seed
+        # produces identical samples whether or not the client streams
+        rng = jax.random.PRNGKey(int(seed))
+        rng, first_rng = jax.random.split(rng)
+        step_rngs = (
+            jax.random.split(rng, max_new - 1) if max_new > 1 else None
+        )
+        emitted: list[int] = []
+        sent = ""
+        with self._lock:
+            logits, cache = pf(
+                self.params, jnp.asarray(padded),
+                lengths=jnp.asarray([len(ids)], jnp.int32),
+            )
+            tok = _sample(
+                logits, first_rng, float(temperature), int(top_k),
+                float(top_p),
+            )
+            for i in range(max_new):
+                t = int(np.asarray(tok)[0])
+                if self.eos_id is not None and t == self.eos_id:
+                    break
+                emitted.append(t)
+                text = self.decode_text(emitted)
+                # a trailing U+FFFD is usually an INCOMPLETE multi-byte
+                # sequence (the next token completes the character and
+                # changes what it decodes to) — hold it back until it
+                # either resolves or stops being the tail
+                stable = text[:-1] if text.endswith("�") else text
+                if stable.startswith(sent) and len(stable) > len(sent):
+                    yield stable[len(sent):]
+                    sent = stable
+                if len(emitted) == max_new:
+                    break
+                tok, cache = step(self.params, cache, tok, step_rngs[i])
+        final = self.decode_text(emitted)
+        if final.startswith(sent) and len(final) > len(sent):
+            yield final[len(sent):]            # flush any held-back tail
+
 
 class _Handler(BaseHTTPRequestHandler):
     state: ServingState  # set by make_server
+    protocol_version = "HTTP/1.1"  # chunked transfer needs 1.1
 
     def log_message(self, fmt, *args):  # route through our logger
         log(self.address_string(), fmt % args)
@@ -189,19 +296,74 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.loads(self.rfile.read(length) or b"{}")
             if not isinstance(body, dict) or "prompt" not in body:
                 raise ValueError('body must be a JSON object with "prompt"')
-            result = self.state.complete(
-                str(body["prompt"]),
+            kwargs = dict(
                 max_new_tokens=body.get("max_new_tokens"),
                 temperature=body.get("temperature", 0.0),
                 top_k=body.get("top_k", 0),
                 top_p=body.get("top_p", 0.0),
                 seed=body.get("seed", 0),
             )
+            if body.get("stream"):
+                # validate (and pay the first device call) BEFORE the
+                # 200 status goes out — errors must still be a 400
+                pieces = self.state.stream(str(body["prompt"]), **kwargs)
+                first = next(pieces, None)
+                return self._stream_chunked(first, pieces)
+            result = self.state.complete(str(body["prompt"]), **kwargs)
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             # TypeError covers wrong-typed JSON fields (e.g. top_k: [1])
             # — a malformed request must be a 400, not a dropped socket
             return self._json(400, {"error": str(e)})
         return self._json(200, result)
+
+    def _stream_chunked(self, first: str | None, pieces) -> None:
+        """Write chunked pieces WITHOUT coupling the chip to the client:
+        a producer thread drains the generator (which holds the
+        generation lock) into an unbounded queue at chip speed — total
+        work is bounded by max_new_tokens — while this thread writes at
+        whatever pace the client reads. A slow or dead reader can never
+        hold the generation lock hostage."""
+        import queue
+
+        q: queue.Queue = queue.Queue()
+
+        def produce():
+            try:
+                for piece in pieces:
+                    q.put(piece)
+            except Exception as e:  # noqa: BLE001 — surfaced via sentinel
+                log(f"stream producer failed: {type(e).__name__}: {e}")
+            finally:
+                q.put(None)
+
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        producer = None
+        if first is not None:
+            producer = threading.Thread(target=produce, daemon=True)
+            producer.start()
+        try:
+            if first is not None:
+                self._write_chunk(first)
+                while (piece := q.get()) is not None:
+                    self._write_chunk(piece)
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            # client went away mid-stream; the producer finishes its
+            # bounded work and releases the lock on its own
+            log("client disconnected mid-stream")
+        finally:
+            if producer is not None:
+                producer.join()
+
+    def _write_chunk(self, piece: str) -> None:
+        data = piece.encode("utf-8")
+        if data:
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
 
 
 def make_server(env: dict | None = None) -> ThreadingHTTPServer:
