@@ -96,6 +96,83 @@ def test_max_new_capped_by_env(server):
     assert data["tokens"] == 8               # SERVE_MAX_NEW cap
 
 
+class TestDynamicBatching:
+    """SERVER_BATCH > 1: concurrent greedy requests coalesce into one
+    ragged batch without changing any response."""
+
+    @pytest.fixture(scope="class")
+    def batch_server(self):
+        srv = make_server(dict(ENV, SERVER_BATCH="4",
+                               SERVER_BATCH_WINDOW_MS="30"))
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        yield srv
+        srv.shutdown()
+
+    def test_concurrent_requests_match_solo(self, server, batch_server):
+        """Fire 4 different prompts concurrently at the batching server;
+        each response must equal the non-batching server's answer."""
+        prompts = ["alpha", "beta gamma", "d", "epsilon zeta eta"]
+        solo = {}
+        for p in prompts:
+            _, data = _request(
+                server, "POST", "/v1/completions",
+                {"prompt": p, "max_new_tokens": 6},
+            )
+            solo[p] = data["text"]
+
+        results = {}
+        errors = []
+
+        def fire(p):
+            try:
+                status, data = _request(
+                    batch_server, "POST", "/v1/completions",
+                    {"prompt": p, "max_new_tokens": 6},
+                )
+                assert status == 200, data
+                results[p] = data["text"]
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append((p, e))
+
+        threads = [
+            threading.Thread(target=fire, args=(p,)) for p in prompts
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert results == solo
+
+    def test_mixed_max_new_truncates_per_request(self, server, batch_server):
+        """Co-riding rows run to the batch max but each response stops at
+        its own request's budget (greedy prefix property)."""
+        _, long = _request(
+            server, "POST", "/v1/completions",
+            {"prompt": "prefix", "max_new_tokens": 8},
+        )
+        results = {}
+
+        def fire(n):
+            _, data = _request(
+                batch_server, "POST", "/v1/completions",
+                {"prompt": "prefix", "max_new_tokens": n},
+            )
+            results[n] = data
+
+        threads = [
+            threading.Thread(target=fire, args=(n,)) for n in (3, 8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert results[8]["text"] == long["text"]
+        assert results[3]["tokens"] == 3
+        assert long["text"].startswith(results[3]["text"])
+
+
 def test_bad_requests_rejected(server):
     status, data = _request(server, "POST", "/v1/completions", {"nope": 1})
     assert status == 400 and "prompt" in data["error"]

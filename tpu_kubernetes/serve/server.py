@@ -23,8 +23,8 @@ Model bring-up reuses the batch job's env contract exactly
 (``load_serving_stack``: SERVE_MODEL / SERVE_HF_CHECKPOINT /
 SERVE_TOKENIZER / SERVE_QUANT), plus SERVE_KV_QUANT for the int8 KV
 cache, SERVE_EOS_ID (tokens after it are truncated from responses),
-SERVER_HOST/SERVER_PORT, and SERVE_MAX_NEW as the per-request
-``max_new_tokens`` cap.
+SERVER_HOST/SERVER_PORT, SERVER_BATCH/SERVER_BATCH_WINDOW_MS (dynamic
+batching), and SERVE_MAX_NEW as the per-request ``max_new_tokens`` cap.
 
 TPU-first serving discipline:
 
@@ -34,10 +34,24 @@ TPU-first serving discipline:
   one per prompt length. Programs are cached by their static signature
   (max_new, sampling knobs) in ServingState, one jitted callable each,
   and jax.jit's shape cache handles the width buckets under it.
-* **One request on the chip at a time.** A lock serializes generation
+* **One program on the chip at a time.** A lock serializes generation
   (the chip is the bottleneck; queueing in the server beats queueing in
   PJRT), while the ThreadingHTTPServer keeps health checks responsive
   during long generations.
+* **Dynamic batching** (SERVER_BATCH > 1): concurrent GREEDY
+  default-sampling requests coalesce into one ragged right-padded batch
+  — the amortize-the-weight-stream lever (docs/design/
+  serving-performance.md) applied to live traffic. Only greedy requests
+  batch, because the ragged-row identity (models/decode.py) makes a
+  batched greedy row token-identical to serving it alone (up to the
+  documented cache-span float-tie caveat, generate ``cache_span``); MoE
+  models serve solo (their capacity is batch-width-dependent) and the
+  dispatcher only joins requests whose COMBINED width/max_new stays in
+  max_seq — two individually-valid requests can be jointly invalid.
+  Sampled/streamed requests run solo. The batch dimension is static
+  (pad rows replicate row 0), so ANY load shares one compiled program
+  per bucket; SERVER_BATCH_WINDOW_MS (default 5) bounds the added
+  latency waiting for co-riders.
 * Startup warms the default bucket so the readiness probe flips only
   when real traffic would be served at full speed.
 
@@ -51,6 +65,7 @@ import json
 import os
 import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
@@ -63,6 +78,70 @@ def _bucket(n: int, lo: int = 16) -> int:
     while b < n:
         b *= 2
     return b
+
+
+class _Batcher:
+    """Coalesce concurrent greedy requests into one ragged batch.
+
+    Requests enqueue and block; a dispatcher thread wakes on the first
+    arrival, waits ``window_ms`` for co-riders, takes up to
+    ``max_batch``, runs ONE batched program, and fans the per-row
+    results back. Each row is truncated to its own requested
+    max_new_tokens (the batch runs to the max), so co-riding never
+    changes a response."""
+
+    def __init__(self, run_batch, max_batch: int, window_ms: float,
+                 fits=None):
+        self._run_batch = run_batch        # (entries) → None, sets results
+        self.max_batch = max_batch
+        self.window_s = window_ms / 1e3
+        # fits(selected, entry): may entry join this batch? (e.g. the
+        # combined width/max_new span must stay within max_seq — two
+        # individually-valid requests can be jointly invalid)
+        self._fits = fits or (lambda selected, entry: True)
+        self._queue: list[dict] = []
+        self._cond = threading.Condition()
+        self._thread = threading.Thread(target=self._dispatch, daemon=True)
+        self._thread.start()
+
+    def submit(self, ids: list, max_new: int) -> list:
+        entry = {
+            "ids": ids, "max_new": max_new,
+            "event": threading.Event(), "tokens": None, "error": None,
+        }
+        with self._cond:
+            self._queue.append(entry)
+            self._cond.notify()
+        entry["event"].wait()
+        if entry["error"] is not None:
+            raise entry["error"]
+        return entry["tokens"]
+
+    def _dispatch(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue:
+                    self._cond.wait()
+            time.sleep(self.window_s)      # let co-riders arrive
+            with self._cond:
+                batch: list[dict] = []
+                rest: list[dict] = []
+                for entry in self._queue:
+                    if len(batch) < self.max_batch and self._fits(batch, entry):
+                        batch.append(entry)
+                    else:
+                        rest.append(entry)   # next dispatch round
+                if not batch:                # head entry fits alone never
+                    batch, rest = [self._queue[0]], self._queue[1:]
+                self._queue = rest
+            try:
+                self._run_batch(batch)
+            except Exception as e:  # noqa: BLE001 — fan the error out
+                for entry in batch:
+                    entry["error"] = e
+            finally:
+                for entry in batch:
+                    entry["event"].set()
 
 
 class ServingState:
@@ -90,6 +169,33 @@ class ServingState:
         # cache keys on callable identity, so a fresh partial per request
         # would re-trace+compile every time
         self._programs: dict = {}
+        batch = int(env.get("SERVER_BATCH", "1"))
+        self._batcher = None
+        from tpu_kubernetes.models import MoEConfig
+
+        if batch > 1 and isinstance(cfg, MoEConfig):
+            # the ragged-row identity batching leans on is weaker for MoE
+            # (capacity is computed at the padded width — co-riders could
+            # change a response); serve MoE solo rather than quietly
+            log("SERVER_BATCH ignored: MoE capacity is batch-width-"
+                "dependent, dynamic batching could change responses")
+        elif batch > 1:
+            def fits(selected: list, entry: dict) -> bool:
+                width = _bucket(max(
+                    [len(entry["ids"])] + [len(e["ids"]) for e in selected]
+                ))
+                max_new = max(
+                    [entry["max_new"]] + [e["max_new"] for e in selected]
+                )
+                # two individually-valid requests can be JOINTLY invalid:
+                # the batch runs at (widest bucket, largest max_new)
+                return width + max_new <= cfg.max_seq
+
+            self._batcher = _Batcher(
+                self._run_greedy_batch, batch,
+                float(env.get("SERVER_BATCH_WINDOW_MS", "5")),
+                fits=fits,
+            )
         self.ready = False
 
     def warm(self) -> None:
@@ -122,10 +228,7 @@ class ServingState:
         return fn
 
     def _validate(self, prompt: str, max_new_tokens: int | None):
-        """Shared request validation → (padded (1, width) np.int32,
-        prompt ids, max_new)."""
-        import numpy as np
-
+        """Shared request validation → (prompt ids, max_new, width)."""
         max_new = (
             self.max_new_cap if max_new_tokens is None
             else int(max_new_tokens)   # 0 is a VALUE (and rejected), not unset
@@ -141,9 +244,45 @@ class ServingState:
                 f"max_new_tokens ({max_new}) exceeds max_seq "
                 f"{self.cfg.max_seq}"
             )
-        padded = np.zeros((1, width), np.int32)
-        padded[0, :len(ids)] = ids
-        return padded, ids, max_new
+        return ids, max_new, width
+
+    @staticmethod
+    def _pad_rows(rows: list, width: int):
+        import numpy as np
+
+        padded = np.zeros((len(rows), width), np.int32)
+        for i, r in enumerate(rows):
+            padded[i, :len(r)] = r
+        return padded
+
+    def _run_greedy_batch(self, entries: list) -> None:
+        """Dispatcher callback: run up to SERVER_BATCH queued greedy
+        requests as ONE ragged batch (static batch dim — pad rows
+        replicate row 0) and set each entry's tokens. A row truncated to
+        its own max_new is identical to generating that much alone:
+        greedy emission is left-to-right and ragged rows are
+        independent."""
+        jax = self._jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        b = self._batcher.max_batch
+        max_new = max(e["max_new"] for e in entries)
+        width = _bucket(max(len(e["ids"]) for e in entries))
+        rows = [e["ids"] for e in entries]
+        rows += [rows[0]] * (b - len(rows))
+        padded = self._pad_rows(rows, width)
+        lengths = jnp.asarray([len(r) for r in rows], jnp.int32)
+
+        fn = self._program(max_new, 0.0, 0, 0.0)
+        with self._lock:
+            out = fn(
+                self.params, jnp.asarray(padded),
+                rng=jax.random.PRNGKey(0), prompt_lengths=lengths,
+            )
+            tokens = np.asarray(out)
+        for i, entry in enumerate(entries):
+            entry["tokens"] = tokens[i][:entry["max_new"]].tolist()
 
     def complete(self, prompt: str, max_new_tokens: int | None = None,
                  temperature: float = 0.0, top_k: int = 0,
@@ -152,17 +291,27 @@ class ServingState:
         import jax.numpy as jnp
         import numpy as np
 
-        padded, ids, max_new = self._validate(prompt, max_new_tokens)
+        ids, max_new, width = self._validate(prompt, max_new_tokens)
 
-        fn = self._program(max_new, float(temperature), int(top_k),
-                           float(top_p))
-        with self._lock:
-            out = fn(
-                self.params, jnp.asarray(padded),
-                rng=jax.random.PRNGKey(int(seed)),
-                prompt_lengths=jnp.asarray([len(ids)], jnp.int32),
-            )
-            tokens = np.asarray(out)[0].tolist()
+        greedy_default = (
+            float(temperature) == 0.0 and int(top_k) == 0
+            and float(top_p) == 0.0
+        )
+        if self._batcher is not None and greedy_default:
+            # greedy rows coalesce without changing output, by the
+            # ragged-row identity (up to the documented cache-span
+            # float-tie caveat — the batch runs at the co-riders' span)
+            tokens = self._batcher.submit(ids, max_new)
+        else:
+            fn = self._program(max_new, float(temperature), int(top_k),
+                               float(top_p))
+            with self._lock:
+                out = fn(
+                    self.params, jnp.asarray(self._pad_rows([ids], width)),
+                    rng=jax.random.PRNGKey(int(seed)),
+                    prompt_lengths=jnp.asarray([len(ids)], jnp.int32),
+                )
+                tokens = np.asarray(out)[0].tolist()
         if self.eos_id is not None and self.eos_id in tokens:
             tokens = tokens[:tokens.index(self.eos_id)]
         return {
@@ -187,9 +336,9 @@ class ServingState:
 
         from tpu_kubernetes.models.decode import _sample, decode_step, prefill
 
-        padded, ids, max_new = self._validate(prompt, max_new_tokens)
+        ids, max_new, width = self._validate(prompt, max_new_tokens)
+        padded = self._pad_rows([ids], width)
         cfg = self.cfg
-        width = padded.shape[1]
 
         # keyed by the SPAN (the only static the compile depends on):
         # different (width, max_new) pairs with one span share a program,
