@@ -49,6 +49,13 @@ def test_healthz_ready(server):
     assert data["model"] == "llama-test"
 
 
+def test_models_listing(server):
+    status, data = _request(server, "GET", "/v1/models")
+    assert status == 200
+    assert data["object"] == "list"
+    assert data["data"][0]["id"] == "llama-test"
+
+
 def test_completion_matches_library_greedy(server):
     status, data = _request(
         server, "POST", "/v1/completions",

@@ -6,6 +6,7 @@ A minimal stdlib server (zero dependencies, air-gap friendly) exposing:
   GET  /healthz            → {"status": "ok", "model": ..., ...}
                              (readiness probe; returns 503 until the
                              first compile has finished warming)
+  GET  /v1/models          → the one resident model, OpenAI-list shaped
   POST /v1/completions     → {"prompt": str, "max_new_tokens"?: int,
                               "temperature"?: float, "top_k"?: int,
                               "top_p"?: float, "seed"?: int,
@@ -423,9 +424,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        st = self.state
+        if self.path == "/v1/models":
+            # the OpenAI-client handshake: one resident model
+            return self._json(200, {
+                "object": "list",
+                "data": [{"id": st.model_name, "object": "model"}],
+            })
         if self.path != "/healthz":
             return self._json(404, {"error": "unknown path"})
-        st = self.state
         if not st.ready:
             return self._json(503, {"status": "warming"})
         return self._json(200, {
