@@ -499,7 +499,9 @@ def measure_decode(model_name: str, batch: int, prompt_len: int,
                 int8_result = {"error": f"{type(e).__name__}: {e}"[:300]}
 
         kv_result = None
-        if os.environ.get("BENCH_DECODE_KV", ""):
+        if os.environ.get("BENCH_DECODE_KV", "").strip().lower() not in (
+            "", "0", "false", "no", "off",
+        ):
             # int8 KV cache (off by default: one more compile on a slow
             # tunneled chip) — halves cache-read bytes; at short bench
             # contexts the roofline barely moves (params dominate), the

@@ -344,7 +344,11 @@ def prefill_chunked(
     ONCE on the final position, not per chunk. Logits match
     :func:`prefill` up to float reduction-order differences (the chunk
     path scores against the growing cache instead of one fused
-    attention)."""
+    attention) — EXCEPT under ``kv_quant``, where each chunk attends the
+    already-quantized history (exactly what later decode steps will see,
+    but unlike whole-prompt ``prefill``, whose own attention stays full
+    precision), so int8 rounding accumulates across chunks and long
+    prompts can diverge beyond reduction-order ties."""
     b, plen = tokens.shape
     if plen % chunk:
         raise ValueError(f"prompt_len {plen} must divide by chunk {chunk}")

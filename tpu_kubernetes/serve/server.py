@@ -81,6 +81,15 @@ def _bucket(n: int, lo: int = 16) -> int:
     return b
 
 
+def _bucket_max_new(n: int, cap: int) -> int:
+    """Round a requested max_new up to a power-of-two bucket (≤ cap):
+    compiled programs are keyed on max_new, so raw client values would
+    mean one compile per distinct request size — a trivially triggerable
+    availability hole with compiles serialized under the generation
+    lock. Responses still truncate to the REQUESTED budget."""
+    return min(_bucket(n, lo=8), cap)
+
+
 class _Batcher:
     """Coalesce concurrent greedy requests into one ragged batch.
 
@@ -132,8 +141,9 @@ class _Batcher:
                         batch.append(entry)
                     else:
                         rest.append(entry)   # next dispatch round
-                if not batch:                # head entry fits alone never
-                    batch, rest = [self._queue[0]], self._queue[1:]
+                # every entry passed _validate, so fits([], head) always
+                # admits the head — a nonempty queue yields a nonempty batch
+                assert batch, "dispatcher selected nothing from a nonempty queue"
                 self._queue = rest
             try:
                 self._run_batch(batch)
@@ -229,7 +239,11 @@ class ServingState:
         return fn
 
     def _validate(self, prompt: str, max_new_tokens: int | None):
-        """Shared request validation → (prompt ids, max_new, width)."""
+        """Shared request validation → (prompt ids, requested max_new,
+        run_max_new, width). ``run_max_new`` is the power-of-two bucket
+        the program actually runs (clamped into max_seq); the response
+        truncates to the REQUESTED budget — greedy emission is
+        left-to-right, so running longer never changes earlier tokens."""
         max_new = (
             self.max_new_cap if max_new_tokens is None
             else int(max_new_tokens)   # 0 is a VALUE (and rejected), not unset
@@ -245,7 +259,11 @@ class ServingState:
                 f"max_new_tokens ({max_new}) exceeds max_seq "
                 f"{self.cfg.max_seq}"
             )
-        return ids, max_new, width
+        run_max_new = min(
+            _bucket_max_new(max_new, self.max_new_cap),
+            self.cfg.max_seq - width,
+        )
+        return ids, max_new, run_max_new, width
 
     @staticmethod
     def _pad_rows(rows: list, width: int):
@@ -292,7 +310,9 @@ class ServingState:
         import jax.numpy as jnp
         import numpy as np
 
-        ids, max_new, width = self._validate(prompt, max_new_tokens)
+        ids, max_new, run_max_new, width = self._validate(
+            prompt, max_new_tokens
+        )
 
         greedy_default = (
             float(temperature) == 0.0 and int(top_k) == 0
@@ -302,9 +322,9 @@ class ServingState:
             # greedy rows coalesce without changing output, by the
             # ragged-row identity (up to the documented cache-span
             # float-tie caveat — the batch runs at the co-riders' span)
-            tokens = self._batcher.submit(ids, max_new)
+            tokens = self._batcher.submit(ids, run_max_new)
         else:
-            fn = self._program(max_new, float(temperature), int(top_k),
+            fn = self._program(run_max_new, float(temperature), int(top_k),
                                float(top_p))
             with self._lock:
                 out = fn(
@@ -313,6 +333,7 @@ class ServingState:
                     prompt_lengths=jnp.asarray([len(ids)], jnp.int32),
                 )
                 tokens = np.asarray(out)[0].tolist()
+        tokens = tokens[:max_new]              # bucketed run → requested budget
         if self.eos_id is not None and self.eos_id in tokens:
             tokens = tokens[:tokens.index(self.eos_id)]
         return {
@@ -337,14 +358,18 @@ class ServingState:
 
         from tpu_kubernetes.models.decode import _sample, decode_step, prefill
 
-        ids, max_new, width = self._validate(prompt, max_new_tokens)
+        ids, max_new, run_max_new, width = self._validate(
+            prompt, max_new_tokens
+        )
         padded = self._pad_rows([ids], width)
         cfg = self.cfg
 
         # keyed by the SPAN (the only static the compile depends on):
         # different (width, max_new) pairs with one span share a program,
-        # keeping the O(log max_seq)-programs discipline
-        span = width + max_new
+        # keeping the O(log max_seq)-programs discipline. The span and
+        # rng schedule use the BUCKETED run_max_new so a seed draws the
+        # same tokens as the fused path; the loop stops at the request.
+        span = width + run_max_new
         pf_key = ("prefill", span)
         pf = self._programs.get(pf_key)
         if pf is None:
@@ -373,7 +398,8 @@ class ServingState:
         rng = jax.random.PRNGKey(int(seed))
         rng, first_rng = jax.random.split(rng)
         step_rngs = (
-            jax.random.split(rng, max_new - 1) if max_new > 1 else None
+            jax.random.split(rng, run_max_new - 1)
+            if run_max_new > 1 else None
         )
         emitted: list[int] = []
         sent = ""
@@ -483,14 +509,16 @@ class _Handler(BaseHTTPRequestHandler):
 
         q: queue.Queue = queue.Queue()
 
+        _FAILED = object()   # mid-stream generation error sentinel
+
         def produce():
             try:
                 for piece in pieces:
                     q.put(piece)
+                q.put(None)
             except Exception as e:  # noqa: BLE001 — surfaced via sentinel
                 log(f"stream producer failed: {type(e).__name__}: {e}")
-            finally:
-                q.put(None)
+                q.put(_FAILED)
 
         self.send_response(200)
         self.send_header("Content-Type", "text/plain; charset=utf-8")
@@ -502,11 +530,23 @@ class _Handler(BaseHTTPRequestHandler):
             producer = threading.Thread(target=produce, daemon=True)
             producer.start()
         try:
+            failed = False
             if first is not None:
                 self._write_chunk(first)
                 while (piece := q.get()) is not None:
+                    if piece is _FAILED:
+                        failed = True
+                        break
                     self._write_chunk(piece)
-            self.wfile.write(b"0\r\n\r\n")
+            if failed:
+                # NO terminal chunk: aborting the chunked body is the
+                # in-band error signal — a clean EOF would make a
+                # truncated completion look like a successful one
+                log("aborting stream after mid-generation failure")
+                self.close_connection = True
+                self.wfile.flush()
+            else:
+                self.wfile.write(b"0\r\n\r\n")
         except (BrokenPipeError, ConnectionResetError):
             # client went away mid-stream; the producer finishes its
             # bounded work and releases the lock on its own
