@@ -54,8 +54,9 @@ from tpu_kubernetes.models.llama import ModelConfig
 
 class SpecStats(NamedTuple):
     """rounds: target chunk passes run; drafted: draft tokens proposed;
-    accepted: draft tokens the target agreed with (the speedup signal:
-    tokens-per-target-pass = emitted / rounds)."""
+    accepted: draft tokens the target agreed with AND that fit the
+    remaining token budget — i.e. draft tokens actually emitted (the
+    speedup signal: tokens-per-target-pass = emitted / rounds)."""
 
     rounds: jax.Array
     drafted: jax.Array
@@ -133,7 +134,12 @@ def _spec_loop(
         stats = SpecStats(
             rounds=stats.rounds + 1,
             drafted=stats.drafted + k,
-            accepted=stats.accepted + j,
+            # in the final round n_emit may clip the matched prefix to
+            # the remaining budget; count only what was emitted so the
+            # logged acceptance rate isn't inflated for short runs.
+            # Unclipped: j drafts + 1 correction emitted → j. Clipped
+            # (n_emit <= j): every emitted token is a matched draft.
+            accepted=stats.accepted + jnp.minimum(j, n_emit),
         )
         return out, cursor, last, cache_t, state, stats
 

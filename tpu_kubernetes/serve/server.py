@@ -128,31 +128,52 @@ class _Batcher:
         return entry["tokens"]
 
     def _dispatch(self) -> None:
+        # the loop body may never raise: submit() blocks forever on a
+        # dead dispatcher, so ANY failure (a fits() bug, a selection
+        # invariant break) fails the affected entries out instead
         while True:
             with self._cond:
                 while not self._queue:
                     self._cond.wait()
             time.sleep(self.window_s)      # let co-riders arrive
             with self._cond:
-                batch: list[dict] = []
-                rest: list[dict] = []
-                for entry in self._queue:
-                    if len(batch) < self.max_batch and self._fits(batch, entry):
+                pending, self._queue = self._queue, []
+            batch: list[dict] = []
+            rest: list[dict] = []
+            err: Exception | None = None
+            try:
+                for entry in pending:
+                    if (len(batch) < self.max_batch
+                            and self._fits(batch, entry)):
                         batch.append(entry)
                     else:
                         rest.append(entry)   # next dispatch round
-                # every entry passed _validate, so fits([], head) always
-                # admits the head — a nonempty queue yields a nonempty batch
-                assert batch, "dispatcher selected nothing from a nonempty queue"
-                self._queue = rest
-            try:
-                self._run_batch(batch)
-            except Exception as e:  # noqa: BLE001 — fan the error out
-                for entry in batch:
-                    entry["error"] = e
-            finally:
-                for entry in batch:
-                    entry["event"].set()
+                if not batch:
+                    # every entry passed _validate, so fits([], head)
+                    # always admits the head; if that invariant ever
+                    # breaks, fail the round out rather than spinning
+                    # on an unselectable head
+                    raise RuntimeError(
+                        "dispatcher selected nothing from a nonempty queue"
+                    )
+            except Exception as e:  # noqa: BLE001 — selection failure
+                batch, rest = pending, []    # taints the whole round
+                err = e
+            else:
+                try:
+                    self._run_batch(batch)
+                except Exception as e:  # noqa: BLE001 — fan the error out
+                    err = e
+            for entry in batch:
+                if err is not None:
+                    entry["error"] = err
+                entry["event"].set()
+            if rest:
+                # re-appending under the lock is enough: the dispatcher
+                # (the only _cond waiter) is this thread, and it loops
+                # straight back to the queue check
+                with self._cond:
+                    self._queue = rest + self._queue
 
 
 class ServingState:
@@ -178,8 +199,12 @@ class ServingState:
         self._jax = jax
         # jitted programs keyed by their STATIC arguments — jax.jit's own
         # cache keys on callable identity, so a fresh partial per request
-        # would re-trace+compile every time
+        # would re-trace+compile every time. Handler threads race on
+        # inserts; the mutex ensures one wrapper per key survives (two
+        # racing wrappers would each pay a full trace+compile under the
+        # generation lock)
         self._programs: dict = {}
+        self._programs_lock = threading.Lock()
         batch = int(env.get("SERVER_BATCH", "1"))
         self._batcher = None
         from tpu_kubernetes.models import MoEConfig
@@ -221,22 +246,30 @@ class ServingState:
         self.ready = True
         log("warm: default programs (fused + streaming) compiled, serving")
 
+    def _cached_program(self, key, build):
+        """Get-or-create a jitted program under the cache mutex. The
+        mutex covers only the jax.jit WRAPPING (cheap); trace+compile
+        happens at first call, serialized by the generation lock."""
+        with self._programs_lock:
+            fn = self._programs.get(key)
+            if fn is None:
+                fn = self._programs[key] = build()
+        return fn
+
     def _program(self, max_new: int, temperature: float, top_k: int,
                  top_p: float):
         import functools
 
         from tpu_kubernetes.models import generate
 
-        key = (max_new, temperature, top_k, top_p)
-        fn = self._programs.get(key)
-        if fn is None:
-            fn = self._jax.jit(functools.partial(
+        return self._cached_program(
+            (max_new, temperature, top_k, top_p),
+            lambda: self._jax.jit(functools.partial(
                 generate, cfg=self.cfg, max_new_tokens=max_new,
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_id=self.eos_id, kv_quant=self.kv_quant,
-            ))
-            self._programs[key] = fn
-        return fn
+            )),
+        )
 
     def _validate(self, prompt: str, max_new_tokens: int | None):
         """Shared request validation → (prompt ids, requested max_new,
@@ -370,17 +403,14 @@ class ServingState:
         # rng schedule use the BUCKETED run_max_new so a seed draws the
         # same tokens as the fused path; the loop stops at the request.
         span = width + run_max_new
-        pf_key = ("prefill", span)
-        pf = self._programs.get(pf_key)
-        if pf is None:
-            pf = jax.jit(functools.partial(
+        pf = self._cached_program(
+            ("prefill", span),
+            lambda: jax.jit(functools.partial(
                 prefill, cfg=cfg, max_seq=span, kv_quant=self.kv_quant,
-            ))
-            self._programs[pf_key] = pf
+            )),
+        )
 
-        step_key = ("step", float(temperature), int(top_k), float(top_p))
-        step = self._programs.get(step_key)
-        if step is None:
+        def _build_step():
             def _step(params, cache, tok, rng):
                 logits, cache = decode_step(params, cache, tok, cfg)
                 nxt = _sample(
@@ -389,8 +419,12 @@ class ServingState:
                 )
                 return nxt, cache
 
-            step = jax.jit(_step)
-            self._programs[step_key] = step
+            return jax.jit(_step)
+
+        step = self._cached_program(
+            ("step", float(temperature), int(top_k), float(top_p)),
+            _build_step,
+        )
 
         # the SAME rng schedule as generate(): the first token draws from
         # split(rng)[1], step i from split(rng, max_new-1)[i] — so a seed
@@ -520,18 +554,20 @@ class _Handler(BaseHTTPRequestHandler):
                 log(f"stream producer failed: {type(e).__name__}: {e}")
                 q.put(_FAILED)
 
-        self.send_response(200)
-        self.send_header("Content-Type", "text/plain; charset=utf-8")
-        self.send_header("Transfer-Encoding", "chunked")
-        self.end_headers()
-
         producer = None
-        if first is not None:
-            producer = threading.Thread(target=produce, daemon=True)
-            producer.start()
         try:
+            # header writes are INSIDE the disconnect handler: a client
+            # gone before the status line still suspends the stream()
+            # generator inside the generation lock, and only the finally
+            # below releases it deterministically
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
             failed = False
             if first is not None:
+                producer = threading.Thread(target=produce, daemon=True)
+                producer.start()
                 self._write_chunk(first)
                 while (piece := q.get()) is not None:
                     if piece is _FAILED:
@@ -554,6 +590,12 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             if producer is not None:
                 producer.join()
+            else:
+                # producer never started (headers failed, or the stream
+                # was empty): close the generator so the with-block
+                # inside stream() releases the generation lock NOW, not
+                # at GC time
+                pieces.close()
 
     def _write_chunk(self, piece: str) -> None:
         data = piece.encode("utf-8")
