@@ -72,9 +72,10 @@ class MoEConfig(ModelConfig):
     # "einsum": GShard dense one-hot dispatch (oracle; O(b·s·E·C·d) flops)
     # "grouped": DROPLESS — sort token rows by expert and run the Pallas
     #   grouped matmul (ops/grouped_matmul.py); no capacity, no drops
-    #   (capacity_factor is ignored). Single-shard experts: the opaque
-    #   kernel hides the expert dim from the pjit partitioner, so keep
-    #   "gather"/"einsum" for expert-parallel meshes.
+    #   (capacity_factor is ignored). On an expert-parallel mesh the layer
+    #   shard_maps itself over the expert axis with an explicit all-to-all
+    #   token exchange (models/moe_ep.py) — the train step activates this
+    #   automatically; manual jits need moe_ep.expert_parallel_context.
     dispatch_mode: str = "gather"
     # MoE-aware remat: save the routing plan + bucketed activations so the
     # backward never re-runs the routing machinery (llama.py:
@@ -253,6 +254,33 @@ def _topk_plan(gates: jax.Array, k: int):
     ])                                                # (k, b, s) f32
     weight = gate_r / jnp.maximum(jnp.sum(gate_r, axis=0), 1e-9)
     return expert_idx, masks, weight
+
+
+def _grouped_sort_plan(gates: jax.Array, k: int, E: int):
+    """Stable expert-sort plan shared by the single-shard grouped branch
+    and the expert-parallel path (models/moe_ep.py) — one implementation,
+    so the two dropless paths can never diverge. (token, choice) row
+    t·k + r is token t's round-r choice; the stable sort preserves token
+    order within each expert.
+
+    Returns (perm, sizes, token_of, inv, weight, first): the sort
+    permutation over the b·s·k rows, per-expert row counts (unpadded —
+    call sites add their own alignment padding), each sorted row's source
+    token, the inverse permutation, the combine weights (k, b, s), and
+    the first-choice one-hot for the balance loss."""
+    expert_idx, masks, weight = _topk_plan(gates, k)
+    _, b, s = expert_idx.shape
+    m = b * s * k
+    e_flat = expert_idx.transpose(1, 2, 0).reshape(m)
+    perm = jnp.argsort(e_flat, stable=True)
+    sizes = jnp.bincount(e_flat, length=E).astype(jnp.int32)
+    token_of = perm // k
+    inv = (
+        jnp.zeros((m,), jnp.int32)
+        .at[perm]
+        .set(jnp.arange(m, dtype=jnp.int32), unique_indices=True)
+    )
+    return perm, sizes, token_of, inv, weight, masks[0]
 
 
 def _route_plan(gates: jax.Array, k: int, capacity: int):
@@ -497,51 +525,58 @@ def moe_sublayer(cfg: MoEConfig, x, layer):
     elif cfg.dispatch_mode == "grouped":
         from jax.ad_checkpoint import checkpoint_name
 
-        k, E = cfg.experts_per_token, cfg.n_experts
-        expert_idx, masks, weight = _topk_plan(gates, k)
-        first = masks[0]
+        from tpu_kubernetes.models.moe_ep import (
+            active_expert_mesh,
+            grouped_ep_mlp,
+        )
 
-        # sort the (token, choice) rows by expert → contiguous groups.
-        # Row t·k + r is token t's round-r choice, so token order within an
-        # expert is preserved (stable sort) and the inverse map is a gather.
-        m_rows = b * s * k
-        m_pad = -(-m_rows // DEFAULT_BLOCK_M) * DEFAULT_BLOCK_M
-        e_flat = expert_idx.transpose(1, 2, 0).reshape(m_rows)
-        perm = jnp.argsort(e_flat, stable=True)
-        sizes = jnp.bincount(e_flat, length=E).astype(jnp.int32)
-        # alignment pad rows ride in the last group; their lhs rows are
-        # zero, so their outputs are zero and nothing gathers them back
-        sizes = sizes.at[E - 1].add(m_pad - m_rows)
-        token_of = perm // k                                  # (M,)
-        inv = (
-            jnp.zeros((m_rows,), jnp.int32)
-            .at[perm]
-            .set(jnp.arange(m_rows, dtype=jnp.int32), unique_indices=True)
-        )
-        perm = checkpoint_name(perm, "moe_plan")
-        sizes = checkpoint_name(sizes, "moe_plan")
-        token_of = checkpoint_name(token_of, "moe_plan")
-        inv = checkpoint_name(inv, "moe_plan")
-        weight = checkpoint_name(weight, "moe_plan")
+        ep_mesh = active_expert_mesh()
+        if ep_mesh is not None:
+            # expert-parallel dropless: shard_map over the expert axis
+            # with an explicit all-to-all token exchange (moe_ep.py) —
+            # the opaque Pallas kernel runs per expert slab. The balance
+            # loss uses the GLOBAL gates via the shared tail below.
+            out = grouped_ep_mlp(cfg, y, gates, layer, ep_mesh)
+            # the same round-0 selection the local plans use, so the aux
+            # regularization can never depend on the mesh shape
+            first = _topk_selection(gates, 1)[1][0]
+        else:
+            k, E = cfg.experts_per_token, cfg.n_experts
+            m_rows = b * s * k
+            m_pad = -(-m_rows // DEFAULT_BLOCK_M) * DEFAULT_BLOCK_M
+            perm, sizes, token_of, inv, weight, first = _grouped_sort_plan(
+                gates, k, E
+            )
+            # alignment pad rows ride in the last group; their lhs rows
+            # are zero, so their outputs are zero and nothing gathers
+            # them back
+            sizes = sizes.at[E - 1].add(m_pad - m_rows)
+            perm = checkpoint_name(perm, "moe_plan")
+            sizes = checkpoint_name(sizes, "moe_plan")
+            token_of = checkpoint_name(token_of, "moe_plan")
+            inv = checkpoint_name(inv, "moe_plan")
+            weight = checkpoint_name(weight, "moe_plan")
 
-        y2 = y.reshape(b * s, d)
-        lhs = jnp.pad(
-            _dispatch_sorted(y2, token_of, inv, k), ((0, m_pad - m_rows), (0, 0))
-        )
-        lhs = checkpoint_name(lhs, "moe_dispatch")
-        gmm = functools.partial(grouped_matmul, use_pallas=cfg.use_pallas)
-        gated = jax.nn.silu(gmm(lhs, layer["w_gate"], sizes)) * gmm(
-            lhs, layer["w_up"], sizes
-        )
-        rows_out = checkpoint_name(
-            gmm(gated, layer["w_down"], sizes), "moe_expert_out"
-        )
-        rows_tok = _unsort_rows(rows_out[:m_rows], inv, perm)
-        w_tok = weight.transpose(1, 2, 0).reshape(b, s, k)
-        out = jnp.sum(
-            rows_tok.reshape(b, s, k, d) * w_tok[..., None].astype(rows_tok.dtype),
-            axis=2,
-        )
+            y2 = y.reshape(b * s, d)
+            lhs = jnp.pad(
+                _dispatch_sorted(y2, token_of, inv, k),
+                ((0, m_pad - m_rows), (0, 0)),
+            )
+            lhs = checkpoint_name(lhs, "moe_dispatch")
+            gmm = functools.partial(grouped_matmul, use_pallas=cfg.use_pallas)
+            gated = jax.nn.silu(gmm(lhs, layer["w_gate"], sizes)) * gmm(
+                lhs, layer["w_up"], sizes
+            )
+            rows_out = checkpoint_name(
+                gmm(gated, layer["w_down"], sizes), "moe_expert_out"
+            )
+            rows_tok = _unsort_rows(rows_out[:m_rows], inv, perm)
+            w_tok = weight.transpose(1, 2, 0).reshape(b, s, k)
+            out = jnp.sum(
+                rows_tok.reshape(b, s, k, d)
+                * w_tok[..., None].astype(rows_tok.dtype),
+                axis=2,
+            )
     else:
         raise ValueError(f"unknown dispatch_mode {cfg.dispatch_mode!r}")
 

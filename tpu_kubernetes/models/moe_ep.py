@@ -1,0 +1,285 @@
+"""Expert-parallel dropless MoE: the grouped (MegaBlocks-shaped) path
+sharded over the ``expert`` mesh axis.
+
+The Pallas grouped matmul (ops/grouped_matmul.py) is opaque to the pjit
+partitioner, so ``dispatch_mode="grouped"`` cannot be auto-sharded the way
+the einsum/gather paths are — XLA cannot see through the kernel to insert
+an all-to-all. This module makes the expert dimension explicit instead:
+:func:`grouped_ep_mlp` runs the expert MLP inside a **partial-manual
+``jax.shard_map``** over ONLY the ``expert`` axis (every other mesh axis
+stays automatic, so data/fsdp batch sharding composes as usual), with the
+token exchange written out by hand:
+
+1. each shard sorts its local (token, choice) rows by global expert
+   (stable, so per-expert token order is preserved);
+2. per-destination-shard row counts are exchanged with one tiny int32
+   ``all_gather`` (the (ep, E) count matrix — everything else is derived
+   from it);
+3. rows travel to their expert's owner through a static-shaped
+   ``lax.all_to_all`` over worst-case bins (bin capacity = all of a
+   shard's rows; pad rows are zeros and fold into the kernel's tail
+   group). ``lax.ragged_all_to_all`` would move only the valid rows —
+   the planned upgrade once XLA:CPU supports it, since the hermetic test
+   environment (and the driver's dry run) is a virtual CPU mesh; the
+   exchange is isolated in this module precisely so that swap is local;
+4. each shard re-sorts received rows by its LOCAL expert slab and runs
+   ``grouped_matmul`` over them — dropless, no capacity, exactly the
+   single-shard grouped path per slab;
+5. the same exchange runs in reverse and each token combines its k rows
+   with the router weights locally.
+
+Every data movement is a gather with a custom-VJP complementary gather
+(the no-row-scatter discipline models/moe.py established); the collective
+transposes are the reverse collectives, which JAX derives automatically
+for ``all_to_all``/``all_gather``.
+
+Entry is trace-time: :func:`expert_parallel_context` records the mesh
+(train/trainer.py wraps the loss in it automatically), and
+models/moe.py's grouped branch delegates here whenever the context mesh
+has a non-trivial ``expert`` axis. Single-shard meshes keep the plain
+grouped path with zero overhead.
+
+Reference: the provisioner (SURVEY §2.7) has no ML code; this completes
+the EP column for the dropless path (VERDICT r04 Missing #2).
+"""
+
+from __future__ import annotations
+
+import functools
+from contextlib import contextmanager
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_kubernetes.ops.grouped_matmul import (
+    DEFAULT_BLOCK_M,
+    _int_zeros,
+    grouped_matmul,
+)
+
+# -- trace-time context ------------------------------------------------------
+
+_EP_MESH = None
+
+
+@contextmanager
+def expert_parallel_context(mesh):
+    """Make ``mesh`` visible to MoE layers traced inside this context, so
+    ``dispatch_mode="grouped"`` can shard_map itself over the mesh's
+    ``expert`` axis. Applied around the loss by
+    train/trainer.py:make_sharded_train_step — user code only needs this
+    when jitting a MoE forward by hand over an expert-parallel mesh."""
+    global _EP_MESH
+    prev = _EP_MESH
+    _EP_MESH = mesh
+    try:
+        yield
+    finally:
+        _EP_MESH = prev
+
+
+def active_expert_mesh():
+    """The context mesh, if it has a non-trivial expert axis, else None."""
+    mesh = _EP_MESH
+    if (
+        mesh is not None
+        and "expert" in mesh.axis_names
+        and mesh.shape["expert"] > 1
+    ):
+        return mesh
+    return None
+
+
+# -- exchange gathers (custom VJP: both directions are gathers) --------------
+
+@jax.custom_vjp
+def _fill_bins(ys, idx, valid, dst_of, pos_of):
+    """Sorted rows → per-destination bins: out[j, q] = ys[idx[j, q]] where
+    valid, else 0. Backward is the complementary gather — every sorted row
+    sits in exactly one bin slot (dst_of, pos_of)."""
+    take = jnp.take(ys, jnp.minimum(idx, ys.shape[0] - 1), axis=0)
+    return jnp.where(valid[..., None], take, jnp.zeros((), ys.dtype))
+
+
+def _fill_bins_fwd(ys, idx, valid, dst_of, pos_of):
+    return _fill_bins(ys, idx, valid, dst_of, pos_of), (
+        idx, valid, dst_of, pos_of
+    )
+
+
+def _fill_bins_bwd(res, dout):
+    idx, valid, dst_of, pos_of = res
+    dys = dout[dst_of, pos_of]
+    return (dys, _int_zeros(idx), _int_zeros(valid),
+            _int_zeros(dst_of), _int_zeros(pos_of))
+
+
+_fill_bins.defvjp(_fill_bins_fwd, _fill_bins_bwd)
+
+
+@jax.custom_vjp
+def _read_bins(bins, dst_of, pos_of, idx, valid):
+    """Per-destination bins → sorted rows: the exact inverse of
+    :func:`_fill_bins` (same metadata), used after the return exchange."""
+    return bins[dst_of, pos_of]
+
+
+def _read_bins_fwd(bins, dst_of, pos_of, idx, valid):
+    return _read_bins(bins, dst_of, pos_of, idx, valid), (
+        dst_of, pos_of, idx, valid
+    )
+
+
+def _read_bins_bwd(res, dout):
+    dst_of, pos_of, idx, valid = res
+    take = jnp.take(dout, jnp.minimum(idx, dout.shape[0] - 1), axis=0)
+    dbins = jnp.where(valid[..., None], take, jnp.zeros((), dout.dtype))
+    return (dbins, _int_zeros(dst_of), _int_zeros(pos_of),
+            _int_zeros(idx), _int_zeros(valid))
+
+
+_read_bins.defvjp(_read_bins_fwd, _read_bins_bwd)
+
+
+# -- the sharded sublayer ----------------------------------------------------
+
+def grouped_ep_mlp(cfg, y, gates, layer, mesh):
+    """Dropless expert MLP over an expert-parallel mesh.
+
+    y (b, s, d) tokens (batch sharded over the data-like axes), gates
+    (b, s, E) float32 router probabilities, layer the scanned layer params
+    (w_gate/w_up/w_down carry a leading global-expert axis sharded over
+    ``expert``) → (b, s, d) combined expert output (no residual).
+
+    Requires n_experts divisible by the expert-axis size. The batch is
+    re-split over the expert axis inside (shard_map in_specs); other mesh
+    axes stay automatic.
+    """
+    from tpu_kubernetes.models.moe import (
+        _dispatch_sorted,
+        _grouped_sort_plan,
+        _unsort_rows,
+    )
+    from jax.ad_checkpoint import checkpoint_name
+    from jax.sharding import PartitionSpec as P
+
+    ep = mesh.shape["expert"]
+    E = cfg.n_experts
+    if E % ep:
+        raise ValueError(
+            f"n_experts {E} not divisible by expert mesh axis {ep}"
+        )
+    e_loc = E // ep
+    k = cfg.experts_per_token
+
+    def inner(y_l, wg_l, wu_l, wd_l, gates_l):
+        p = lax.axis_index("expert")
+        b_l, s, d = y_l.shape
+        m_l = b_l * s * k
+
+        # -- local routing plan: the SAME sort plan as the single-shard
+        # grouped branch (moe.py:_grouped_sort_plan), applied to this
+        # shard's local tokens
+        perm, sizes, token_of, inv, weight, _ = _grouped_sort_plan(
+            gates_l, k, E
+        )
+
+        # -- exchange metadata: ONE int32 all_gather of per-expert counts,
+        # everything else derived locally --------------------------------
+        send = sizes.reshape(ep, e_loc).sum(1)              # rows per dst
+        dst_start = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(send)[:-1]]
+        )
+        counts = lax.all_gather(sizes, "expert")            # (ep, E)
+        c_re = counts.reshape(ep, ep, e_loc)[:, p, :]       # (src, E_loc)
+        recv = c_re.sum(1)                                  # rows per src
+
+        q = jnp.arange(m_l, dtype=jnp.int32)
+        # sorted row i lives in bin (dst_of[i], pos_of[i]); bin slot
+        # (j, q) reads sorted row idx[j, q] while q < send[j]
+        dst_of = jnp.clip(
+            jnp.searchsorted(jnp.cumsum(send), q, side="right"), 0, ep - 1
+        ).astype(jnp.int32)
+        pos_of = q - dst_start[dst_of]
+        idx = dst_start[:, None] + q[None, :]               # (ep, m_l)
+        valid_bin = q[None, :] < send[:, None]
+
+        perm = checkpoint_name(perm, "moe_plan")
+        inv = checkpoint_name(inv, "moe_plan")
+        token_of = checkpoint_name(token_of, "moe_plan")
+        weight = checkpoint_name(weight, "moe_plan")
+
+        # -- dispatch: token rows → expert-sorted → bins → all_to_all ----
+        ys = _dispatch_sorted(y_l.reshape(b_l * s, d), token_of, inv, k)
+        bins = _fill_bins(ys, idx, valid_bin, dst_of, pos_of)
+        got = lax.all_to_all(bins, "expert", 0, 0, tiled=False)
+
+        # -- local re-sort by my expert slab -----------------------------
+        # chunk from src i: rows for my experts, sorted by expert, with
+        # per-expert counts c_re[i]; invalid tail rows are zeros and sort
+        # to the end (key e_loc), where the kernel's pad group eats them
+        cap = ep * m_l
+        csum = jnp.cumsum(c_re, axis=1)                     # (src, E_loc)
+        qv = jnp.arange(m_l, dtype=jnp.int32)
+        eloc = jax.vmap(
+            lambda c, r: jnp.searchsorted(c, r, side="right")
+        )(csum, jnp.broadcast_to(qv, (ep, m_l)))
+        key = jnp.where(qv[None, :] < recv[:, None], eloc, e_loc)
+        perm2 = jnp.argsort(key.reshape(-1), stable=True).astype(jnp.int32)
+        inv2 = (
+            jnp.zeros((cap,), jnp.int32)
+            .at[perm2]
+            .set(jnp.arange(cap, dtype=jnp.int32), unique_indices=True)
+        )
+        perm2 = checkpoint_name(perm2, "moe_plan")
+        inv2 = checkpoint_name(inv2, "moe_plan")
+        # _unsort_rows is "permute by known inverse": rows[perm2] with
+        # cotangent rows[inv2]
+        ys2 = _unsort_rows(got.reshape(cap, d), perm2, inv2)
+
+        sizes_loc = c_re.sum(0)                             # (E_loc,)
+        cap_pad = -(-cap // DEFAULT_BLOCK_M) * DEFAULT_BLOCK_M
+        total = jnp.sum(sizes_loc)
+        # alignment + invalid rows ride in the last group (zero lhs rows
+        # → zero outputs), exactly like the single-shard path's m_pad
+        sizes_loc = sizes_loc.at[e_loc - 1].add(cap_pad - total)
+        lhs = jnp.pad(ys2, ((0, cap_pad - cap), (0, 0)))
+        lhs = checkpoint_name(lhs, "moe_dispatch")
+
+        gmm = functools.partial(grouped_matmul, use_pallas=cfg.use_pallas)
+        gated = jax.nn.silu(gmm(lhs, wg_l, sizes_loc)) * gmm(
+            lhs, wu_l, sizes_loc
+        )
+        rows_out = checkpoint_name(
+            gmm(gated, wd_l, sizes_loc), "moe_expert_out"
+        )
+
+        # -- return exchange: unsort to chunk order, all_to_all back,
+        # read my rows out of the bins they came back in ------------------
+        back = _unsort_rows(rows_out[:cap], inv2, perm2)
+        ret = lax.all_to_all(
+            back.reshape(ep, m_l, d), "expert", 0, 0, tiled=False
+        )
+        back_sorted = _read_bins(ret, dst_of, pos_of, idx, valid_bin)
+
+        rows_tok = _unsort_rows(back_sorted, inv, perm)
+        w_tok = weight.transpose(1, 2, 0).reshape(b_l, s, k)
+        return jnp.sum(
+            rows_tok.reshape(b_l, s, k, d)
+            * w_tok[..., None].astype(rows_tok.dtype),
+            axis=2,
+        )
+
+    return jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(
+            P("expert"), P("expert"), P("expert"), P("expert"), P("expert"),
+        ),
+        out_specs=P("expert"),
+        axis_names={"expert"},
+        # the routing plan genuinely varies per expert shard; VMA checking
+        # would reject the deliberate divergence
+        check_vma=False,
+    )(y, layer["w_gate"], layer["w_up"], layer["w_down"], gates)

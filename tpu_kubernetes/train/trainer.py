@@ -193,6 +193,25 @@ def opt_state_shardings(opt_state, params_like, target_shardings, replicated):
     return rec(opt_state)
 
 
+def _with_expert_parallel(loss: Callable | None, mesh: Mesh) -> Callable | None:
+    """Wrap a loss so MoE layers see the mesh at trace time
+    (models/moe_ep.py): dispatch_mode="grouped" then shard_maps itself
+    over the expert axis instead of hitting the opaque-kernel wall. A
+    no-op for meshes without a non-trivial expert axis."""
+    if "expert" not in mesh.axis_names or mesh.shape["expert"] <= 1:
+        return loss
+    from tpu_kubernetes.models.moe_ep import expert_parallel_context
+
+    lossf = loss or loss_fn
+
+    @functools.wraps(lossf)
+    def wrapped(params, batch, cfg):
+        with expert_parallel_context(mesh):
+            return lossf(params, batch, cfg)
+
+    return wrapped
+
+
 def make_sharded_train_step(
     cfg: ModelConfig, tc: TrainConfig, mesh: Mesh, state: dict[str, Any],
     loss: Callable | None = None, p_shardings: Any = None,
@@ -202,7 +221,9 @@ def make_sharded_train_step(
     shardings = state_shardings(state, cfg, mesh, p_shardings=p_shardings)
     b_sharding = batch_sharding(mesh)
     step = jax.jit(
-        functools.partial(train_step, cfg=cfg, tc=tc, loss=loss),
+        functools.partial(
+            train_step, cfg=cfg, tc=tc, loss=_with_expert_parallel(loss, mesh)
+        ),
         in_shardings=(shardings, b_sharding),
         out_shardings=(shardings, NamedSharding(mesh, PartitionSpec())),
         donate_argnums=(0,),
@@ -239,9 +260,10 @@ def make_eval_step(
     same mesh/shardings as training, nothing donated (params survive)."""
     shardings = state_shardings(state, cfg, mesh)
     b_sharding = batch_sharding(mesh)
+    lossf = _with_expert_parallel(None, mesh) or loss_fn
 
     def eval_step(params, batch):
-        return loss_fn(params, batch, cfg)
+        return lossf(params, batch, cfg)
 
     step = jax.jit(
         eval_step,
