@@ -157,3 +157,71 @@ def test_cli_subprocess(tmp_path, prompts_file):
     assert r.returncode == 0, r.stderr
     assert len(out.read_text().splitlines()) == 3
     assert "tok/s" in r.stderr
+
+
+def test_multihost_serving_token_parity(tmp_path, prompts_file):
+    """Two jax.distributed processes (4 virtual CPU devices each) serve
+    the same prompts file over one 8-device global mesh and must produce
+    byte-identical completions to the single-process 8-device run — the
+    v5p-32 (4-host) serving story, scaled down. Only process 0 writes."""
+    import os
+    import socket
+
+    repo = Path(__file__).resolve().parent.parent
+    common = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "SERVE_PROMPTS": str(prompts_file),
+        "SERVE_MODEL": "llama-test",
+        "SERVE_MAX_NEW": "6",
+        "SERVE_BATCH": "2",
+    }
+    for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+              "JAX_PROCESS_ID"):
+        common.pop(k, None)
+
+    ref_out = tmp_path / "ref.txt"
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_kubernetes.serve.job"],
+        capture_output=True, text=True, timeout=420, cwd=repo,
+        env={**common,
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+             "SERVE_OUT": str(ref_out)},
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for pid in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "tpu_kubernetes.serve.job"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=repo,
+            env={**common,
+                 "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                 "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+                 "JAX_NUM_PROCESSES": "2",
+                 "JAX_PROCESS_ID": str(pid),
+                 "SERVE_OUT": str(tmp_path / f"mh{pid}.txt")},
+        ))
+    errs = []
+    try:
+        for p in procs:
+            _, err = p.communicate(timeout=420)
+            errs.append(err)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    failed = [
+        (i, errs[i][-2000:]) for i, p in enumerate(procs)
+        if p.returncode != 0 and i < len(errs)
+    ]
+    assert not failed, failed
+    assert "process 0/2" in errs[0] and "process 1/2" in errs[1]
+    assert (tmp_path / "mh0.txt").read_text() == ref_out.read_text()
+    # only process 0 writes the output file
+    assert not (tmp_path / "mh1.txt").exists()

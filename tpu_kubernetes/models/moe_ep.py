@@ -221,11 +221,10 @@ def grouped_ep_mlp(cfg, y, gates, layer, mesh):
         # to the end (key e_loc), where the kernel's pad group eats them
         cap = ep * m_l
         csum = jnp.cumsum(c_re, axis=1)                     # (src, E_loc)
-        qv = jnp.arange(m_l, dtype=jnp.int32)
         eloc = jax.vmap(
             lambda c, r: jnp.searchsorted(c, r, side="right")
-        )(csum, jnp.broadcast_to(qv, (ep, m_l)))
-        key = jnp.where(qv[None, :] < recv[:, None], eloc, e_loc)
+        )(csum, jnp.broadcast_to(q, (ep, m_l)))
+        key = jnp.where(q[None, :] < recv[:, None], eloc, e_loc)
         perm2 = jnp.argsort(key.reshape(-1), stable=True).astype(jnp.int32)
         inv2 = (
             jnp.zeros((cap,), jnp.int32)

@@ -140,7 +140,14 @@ def load_serving_stack(env: dict):
 
 def run_serving(env: dict | None = None) -> list[str]:
     """The whole pipeline; ``env`` defaults to os.environ (injectable for
-    tests). Returns the completions (also written to SERVE_OUT)."""
+    tests). Returns the completions (also written to SERVE_OUT).
+
+    Multi-host: consumes the provisioner's env contract exactly like the
+    trainer (train/job.py) — ``initialize()`` assembles the slice over
+    DCN before any device use, the mesh spans GLOBAL devices, every
+    process runs the same compiled calls (outputs are replicated), and
+    only process 0 writes SERVE_OUT/stdout. A v5p-32 slice (4 hosts)
+    serves with one tensor-parallel program the same way it trains."""
     env = dict(os.environ if env is None else env)
 
     import jax
@@ -148,7 +155,17 @@ def run_serving(env: dict | None = None) -> list[str]:
     import numpy as np
 
     from tpu_kubernetes.models import CONFIGS, init_params
-    from tpu_kubernetes.parallel import create_mesh, make_sharded_generate
+    from tpu_kubernetes.parallel import (
+        create_mesh,
+        initialize,
+        make_sharded_generate,
+    )
+
+    denv = initialize(env)
+    is_primary = denv.process_id == 0
+    if denv.multi_host:
+        log(f"process {denv.process_id}/{denv.num_processes} "
+            f"accelerator={denv.accelerator_type}")
 
     prompts_path = env.get("SERVE_PROMPTS", "")
     if not prompts_path:
@@ -309,7 +326,20 @@ def run_serving(env: dict | None = None) -> list[str]:
             cache_span=int(span_env) if span_env else None,
             kv_quant=kv_quant,
         )
-        params = jax.device_put(params, p_sh)
+
+        def to_global(x, sh):
+            """Host data (identical on every process) → a global array:
+            device_put cannot target non-addressable devices, so the
+            multi-host path assembles shards via make_array_from_callback
+            (each process fills exactly its addressable pieces)."""
+            if not denv.multi_host:
+                return jax.device_put(x, sh)
+            x = np.asarray(x)
+            return jax.make_array_from_callback(
+                x.shape, sh, lambda idx, x=x: x[idx]
+            )
+
+        params = jax.tree.map(lambda p, s: to_global(p, s), params, p_sh)
         rng = jax.random.PRNGKey(int(env.get("SERVE_SEED", "0")))
 
         t0 = time.perf_counter()
@@ -323,9 +353,11 @@ def run_serving(env: dict | None = None) -> list[str]:
                 padded[i, :len(r)] = r
             rng, call_rng = jax.random.split(rng)
             out = fn(
-                params, jax.device_put(jnp.asarray(padded), b_sh),
+                params, to_global(padded, b_sh),
                 rng=call_rng, prompt_lengths=lengths,
             )
+            # out is replicated (parallel/serving.py out_shardings), so
+            # every process can read it and completions stay identical
             for row in np.asarray(out)[:n_real]:
                 finish(row.tolist())
     dt = time.perf_counter() - t0
@@ -339,11 +371,14 @@ def run_serving(env: dict | None = None) -> list[str]:
         for c in completions
     ]
     text = "\n".join(escaped) + "\n"
-    if out_path == "-":
-        sys.stdout.write(text)
-    else:
-        Path(out_path).write_text(text, encoding="utf-8")
-        log(f"wrote {out_path}")
+    if is_primary:
+        # multi-host: every process computed identical (replicated)
+        # completions; exactly one writes them
+        if out_path == "-":
+            sys.stdout.write(text)
+        else:
+            Path(out_path).write_text(text, encoding="utf-8")
+            log(f"wrote {out_path}")
     return completions
 
 

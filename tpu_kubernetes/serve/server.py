@@ -618,6 +618,20 @@ def make_server(env: dict | None = None) -> ThreadingHTTPServer:
 
 
 def main() -> int:
+    from tpu_kubernetes.parallel import read_env
+
+    denv = read_env()
+    if denv.multi_host:
+        # request-driven generation would need every process to enter
+        # the same compiled call for every request (a broadcast-driven
+        # follower loop); the BATCH entrypoint already serves multi-host
+        # slices (serve/job.py + serve-llama-v5p32.yaml). Refuse loudly
+        # rather than silently serving a mesh over one host's chips.
+        raise SystemExit(
+            f"the HTTP server is single-host (found JAX_NUM_PROCESSES="
+            f"{denv.num_processes}); use `python -m tpu_kubernetes.serve."
+            f"job` for multi-host slice serving"
+        )
     server = make_server()
     host, port = server.server_address[:2]
     log(f"listening on {host}:{port}")
