@@ -307,3 +307,47 @@ class TestKVQuant:
                 cache_span=plen + 6,
             )
             np.testing.assert_array_equal(np.asarray(got[i]), np.asarray(ref[0]))
+
+
+def test_ragged_decode_chunk_matches_sequential_steps(params):
+    """decode_chunk over a ragged (right-padded) batch == the same c
+    tokens fed through sequential decode_steps — the verification
+    primitive now composes with the server's bucketed prompt widths
+    (speculative decoding over padded prompts)."""
+    from tpu_kubernetes.models.decode import decode_chunk
+
+    lengths = [5, 8]
+    plen = max(lengths)
+    padded = jnp.stack([
+        jnp.pad(
+            jax.random.randint(
+                jax.random.PRNGKey(40 + i), (n,), 0, CFG.vocab_size
+            ),
+            (0, plen - n),
+        )
+        for i, n in enumerate(lengths)
+    ])
+    logits0, cache = prefill(
+        params, padded, CFG, max_seq=32,
+        lengths=jnp.asarray(lengths, jnp.int32),
+    )
+    tok = jnp.argmax(logits0, axis=-1).astype(jnp.int32)
+
+    chunk = [tok]
+    c_step = cache
+    seq_logits = []
+    for _ in range(3):
+        lg, c_step = decode_step(params, c_step, chunk[-1], CFG)
+        seq_logits.append(lg)
+        chunk.append(jnp.argmax(lg, axis=-1).astype(jnp.int32))
+
+    chunk_logits, c_chunk = decode_chunk(
+        params, cache, jnp.stack(chunk[:3], axis=1), CFG
+    )
+    np.testing.assert_allclose(
+        np.asarray(chunk_logits),
+        np.asarray(jnp.stack(seq_logits, axis=1)),
+        atol=2e-4, rtol=2e-4,
+    )
+    assert int(c_chunk.length) == int(c_step.length)
+    assert c_chunk.prompt_lengths is not None

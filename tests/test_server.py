@@ -262,3 +262,94 @@ def test_repeat_request_hits_program_cache(server):
     _request(server, "POST", "/v1/completions", req)
     assert len(handler_state._programs) == n_after_first
     assert n_after_first >= len(before)
+
+
+# -- prompt-lookup speculation (SERVE_PROMPT_LOOKUP) ------------------------
+
+@pytest.fixture(scope="module")
+def lookup_server():
+    # f32: the exactness comparison below is across PROGRAMS (fused
+    # generate vs chunk-verification at a draft_k-larger span); bf16
+    # argmax flips on near-tied random-init logits between program
+    # shapes — the documented span caveat, models/speculative.py
+    srv = make_server(dict(
+        ENV, SERVE_PROMPT_LOOKUP="1", SERVE_DTYPE="float32",
+    ))
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def f32_server():
+    srv = make_server(dict(ENV, SERVE_DTYPE="float32"))
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv
+    srv.shutdown()
+
+
+def test_lookup_completion_token_exact_vs_plain(f32_server, lookup_server):
+    """The speculative endpoint must return EXACTLY the non-speculative
+    greedy response — proposals only change speed, verification keeps
+    the target's own argmaxes — and surface acceptance telemetry."""
+    req = {"prompt": "speculate speculate speculate", "max_new_tokens": 6}
+    _, plain = _request(f32_server, "POST", "/v1/completions", req)
+    status, spec = _request(lookup_server, "POST", "/v1/completions", req)
+    assert status == 200
+    assert spec["text"] == plain["text"]
+    assert spec["tokens"] == plain["tokens"]
+    assert "spec" in spec and spec["spec"]["rounds"] >= 1
+    assert 0 <= spec["spec"]["accepted"] <= spec["spec"]["drafted"]
+    # cumulative totals ride the health endpoint
+    _, health = _request(lookup_server, "GET", "/healthz")
+    assert health["prompt_lookup"]["draft_k"] == 8
+    assert health["prompt_lookup"]["rounds"] >= spec["spec"]["rounds"]
+
+
+def test_lookup_streaming_matches_non_streamed(lookup_server):
+    """Streaming under speculation yields whole accepted rounds; the
+    concatenation must equal the non-streamed speculative text."""
+    req = {"prompt": "stream and speculate", "max_new_tokens": 6}
+    _, plain = _request(lookup_server, "POST", "/v1/completions", req)
+
+    host, port = lookup_server.server_address[:2]
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request(
+        "POST", "/v1/completions",
+        body=json.dumps({**req, "stream": True}),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    assert resp.status == 200
+    text = resp.read().decode("utf-8")
+    conn.close()
+    assert text == plain["text"]
+
+
+def test_lookup_sampled_requests_bypass_speculation(lookup_server):
+    """Sampling is not greedy — those requests take the normal solo path
+    (no spec telemetry) and still succeed."""
+    status, data = _request(
+        lookup_server, "POST", "/v1/completions",
+        {"prompt": "sample", "max_new_tokens": 4, "temperature": 0.9,
+         "seed": 7},
+    )
+    assert status == 200
+    assert "spec" not in data
+
+
+def test_lookup_config_rejections():
+    with pytest.raises(ValueError, match="SERVER_BATCH"):
+        make_server(dict(
+            ENV, SERVE_PROMPT_LOOKUP="1", SERVER_BATCH="4",
+        ))
+    with pytest.raises(ValueError, match="KV_QUANT"):
+        make_server(dict(
+            ENV, SERVE_PROMPT_LOOKUP="1", SERVE_KV_QUANT="1",
+        ))
+    with pytest.raises(ValueError, match="dense"):
+        make_server(dict(
+            ENV, SERVE_PROMPT_LOOKUP="1", SERVE_MODEL="moe-test",
+        ))

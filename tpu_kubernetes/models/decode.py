@@ -160,7 +160,8 @@ def _attend_cache(cfg, q, k_cache, v_cache, limits,
     slots = jnp.arange(k_cache.shape[2])
     mask = slots < limits[..., None]                # (c, S) | (b, c, S)
     if prompt_lengths is not None:
-        # ragged batches are single-token (limits (b, 1), mask (b, 1, S))
+        # ragged chunks: limits (b, c), mask (b, c, S) — c=1 is the
+        # classic decode step, c>1 is chunk verification
         real = (
             (slots[None, :] < prompt_lengths[:, None])
             | (slots[None, :] >= prompt_slots)
@@ -186,8 +187,9 @@ def _decode_block(cfg, cos, sin, pos, li, x, layer, kv_state,
     None, None for a full-precision cache) — threaded through with layer
     ``li``'s slice updated in place (one c-position dynamic_update_slice
     on the scan carry — see module docstring). c == 1 is the classic
-    decode step; c > 1 is chunk verification (ragged prompts are
-    single-token only). → (x, kv_state)."""
+    decode step; c > 1 is chunk verification — uniform or ragged (the
+    chunk occupies uniform slots past the ragged prompt region, so each
+    row's positions stay gapless). → (x, kv_state)."""
     b, c, _ = x.shape
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -195,15 +197,17 @@ def _decode_block(cfg, cos, sin, pos, li, x, layer, kv_state,
     q = (y @ _w(layer["wq"], cfg.dtype)).reshape(b, c, h, hd).transpose(0, 2, 1, 3)
     k = (y @ _w(layer["wk"], cfg.dtype)).reshape(b, c, kv, hd).transpose(0, 2, 1, 3)
     v = (y @ _w(layer["wv"], cfg.dtype)).reshape(b, c, kv, hd).transpose(0, 2, 1, 3)
+    off = jnp.arange(c, dtype=jnp.int32)
     if prompt_lengths is not None:
-        # ragged rows: the token in SLOT pos is row i's LOGICAL position
-        # prompt_lengths[i] + (pos - prompt_slots) — gapless per row
-        positions = (prompt_lengths + (pos - prompt_slots))[:, None]  # (b, 1)
-        limits = (pos + 1)[None, None]                       # (1, 1) → (b, c)
-        limits = jnp.broadcast_to(limits, (b, 1))
+        # ragged rows: the token in SLOT pos+j is row i's LOGICAL position
+        # prompt_lengths[i] + (pos - prompt_slots) + j — gapless per row
+        positions = (
+            prompt_lengths[:, None] + (pos - prompt_slots) + off[None, :]
+        )                                                    # (b, c)
+        # chunk row j sees the history plus chunk rows ≤ j
+        limits = jnp.broadcast_to((pos + 1 + off)[None, :], (b, c))
     else:
-        positions = pos + jnp.arange(c, dtype=jnp.int32)     # (c,)
-        # chunk row i sees the history plus chunk rows ≤ i
+        positions = pos + off                                # (c,)
         limits = positions + 1
     q = apply_rope(q, cos, sin, positions=positions)
     k = apply_rope(k, cos, sin, positions=positions)
@@ -422,8 +426,10 @@ def decode_chunk(
     slots below its own position — causal within the chunk, full against
     the history. This is the verification primitive for speculative
     decoding (models/speculative.py), where the target model scores k
-    draft tokens in one pass instead of k sequential steps. Uniform
-    batches only (no ragged prompts)."""
+    draft tokens in one pass instead of k sequential steps. Ragged
+    (right-padded) prompt batches are supported the same way as
+    :func:`decode_step`: the chunk lands in the uniform generation
+    region and the pad slots stay masked."""
     x, cache = _decode_chunk_hidden(params, cache, tokens, cfg)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
@@ -436,8 +442,6 @@ def _decode_chunk_hidden(
     """decode_chunk minus the head: (b, c) tokens → (final hidden states
     (b, c, d) pre-norm, advanced cache). Chunked prefill scans this so
     the O(c·vocab) logits matmul runs once at the end, not per chunk."""
-    if cache.prompt_lengths is not None:
-        raise ValueError("decode_chunk supports uniform batches only")
     c = tokens.shape[1]
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     pos = cache.length
@@ -447,7 +451,8 @@ def _decode_chunk_hidden(
         x, kv_state = carry
         layer, li = xs
         x, kv_state = _decode_block(
-            cfg, cos, sin, pos, li, x, layer, kv_state
+            cfg, cos, sin, pos, li, x, layer, kv_state,
+            cache.prompt_lengths, cache.prompt_slots,
         )
         return (x, kv_state), None
 
@@ -458,7 +463,9 @@ def _decode_chunk_hidden(
         (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
     )
     return x, KVCache(
-        k=k_new, v=v_new, length=pos + c, k_scale=ks_new, v_scale=vs_new
+        k=k_new, v=v_new, length=pos + c,
+        prompt_lengths=cache.prompt_lengths, prompt_slots=cache.prompt_slots,
+        k_scale=ks_new, v_scale=vs_new,
     )
 
 

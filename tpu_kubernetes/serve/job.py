@@ -27,6 +27,10 @@ whole pipeline is env-driven like the trainer:
                        (default: tensor over all local devices)
   SERVE_QUANT          'int8' → weight-only quantized export
                        (models/quant.py); empty = model dtype
+  SERVE_DTYPE          'float32' | 'bfloat16': override the compute
+                       dtype (f32 makes greedy responses bitwise-
+                       comparable across serving modes/spans — the
+                       debugging/eval knob, 2x weight bytes)
   SERVE_KV_QUANT       =1: int8 KV cache (half the cache bytes per
                        decode step; bounded attention rounding —
                        models/decode.KVCache). Composes with SERVE_QUANT;
@@ -119,6 +123,28 @@ def load_serving_stack(env: dict):
         params = init_params(jax.random.PRNGKey(0), cfg)
         log(f"random-init {env.get('SERVE_MODEL', 'llama-test')} "
             "(smoke mode — set SERVE_HF_CHECKPOINT for real weights)")
+    dtype_env = env.get("SERVE_DTYPE", "")
+    if dtype_env:
+        # SERVE_DTYPE=float32: serve above the model's storage precision.
+        # bf16 argmax can flip on near-tied logits when the cache span or
+        # program shape changes (models/speculative.py's caveat); f32
+        # makes responses bitwise-comparable across serving modes —
+        # the debugging/eval knob, at 2x the weight bytes
+        import jax.numpy as jnp
+        from dataclasses import replace as _replace
+
+        dtypes = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+        if dtype_env not in dtypes:
+            raise SystemExit(
+                f"SERVE_DTYPE must be one of {sorted(dtypes)}, "
+                f"got {dtype_env!r}"
+            )
+        cfg = _replace(cfg, dtype=dtypes[dtype_env])
+        params = jax.tree.map(
+            lambda p: p.astype(dtypes[dtype_env])
+            if p.dtype in (jnp.bfloat16, jnp.float32) else p,
+            params,
+        )
     if vocab > cfg.vocab_size:
         raise SystemExit(
             f"tokenizer vocab {vocab} exceeds model vocab {cfg.vocab_size}"

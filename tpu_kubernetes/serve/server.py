@@ -25,7 +25,14 @@ Model bring-up reuses the batch job's env contract exactly
 SERVE_TOKENIZER / SERVE_QUANT), plus SERVE_KV_QUANT for the int8 KV
 cache, SERVE_EOS_ID (tokens after it are truncated from responses),
 SERVER_HOST/SERVER_PORT, SERVER_BATCH/SERVER_BATCH_WINDOW_MS (dynamic
-batching), and SERVE_MAX_NEW as the per-request ``max_new_tokens`` cap.
+batching), SERVE_MAX_NEW as the per-request ``max_new_tokens`` cap, and
+SERVE_PROMPT_LOOKUP (+SERVE_DRAFT_K/SERVE_NGRAM) — draft-model-free
+speculative decoding for greedy requests, streaming included: host-side
+n-gram proposals verified by one jitted (k+1)-token chunk per round, so
+accepted guesses cut the per-token weight streams the request pays.
+Token-exact (verification keeps the target's own greedy choices); the
+per-request acceptance telemetry rides the response ``spec`` field and
+cumulative totals ride /healthz.
 
 TPU-first serving discipline:
 
@@ -190,6 +197,17 @@ class ServingState:
         self.encode, self.decode_text = encode, decode_text
         self.max_new_cap = int(env.get("SERVE_MAX_NEW", "64"))
         self.kv_quant = truthy_env(env, "SERVE_KV_QUANT")
+        # SERVE_PROMPT_LOOKUP: draft-model-free speculation for solo
+        # GREEDY requests (models/speculative.py's n-gram idea, run as a
+        # host-driven loop so streaming works): jitted prefill at the
+        # bucketed width + a jitted (k+1)-token ragged chunk-verify
+        # program; proposals cost nothing and never change tokens —
+        # acceptance keeps exactly the target's greedy choices.
+        self.prompt_lookup = truthy_env(env, "SERVE_PROMPT_LOOKUP")
+        self.draft_k = int(env.get("SERVE_DRAFT_K", "8"))
+        self.ngram = int(env.get("SERVE_NGRAM", "2"))
+        self.spec_totals = {"rounds": 0, "drafted": 0, "accepted": 0}
+        self._last_spec: dict | None = None
         eos_env = env.get("SERVE_EOS_ID", "")
         self.eos_id = int(eos_env) if eos_env else None
         self.model_name = env.get("SERVE_HF_CHECKPOINT", "") or env.get(
@@ -208,6 +226,30 @@ class ServingState:
         batch = int(env.get("SERVER_BATCH", "1"))
         self._batcher = None
         from tpu_kubernetes.models import MoEConfig
+
+        if self.prompt_lookup:
+            # mirror the batch job's loud config rejections (serve/job.py)
+            if isinstance(cfg, MoEConfig):
+                raise ValueError(
+                    "SERVE_PROMPT_LOOKUP needs a dense model (MoE chunk "
+                    "verification is not token-exact)"
+                )
+            if self.kv_quant:
+                raise ValueError(
+                    "SERVE_PROMPT_LOOKUP and SERVE_KV_QUANT are exclusive "
+                    "(exact verification uses a full-precision cache)"
+                )
+            if self.draft_k < 1 or self.ngram < 1:
+                raise ValueError(
+                    f"SERVE_DRAFT_K ({self.draft_k}) and SERVE_NGRAM "
+                    f"({self.ngram}) must be >= 1"
+                )
+            if batch > 1:
+                raise ValueError(
+                    "SERVE_PROMPT_LOOKUP and SERVER_BATCH are exclusive "
+                    "strategies (speculation is batch-1; batching "
+                    "amortizes throughput) — pick one"
+                )
 
         if batch > 1 and isinstance(cfg, MoEConfig):
             # the ragged-row identity batching leans on is weaker for MoE
@@ -286,15 +328,20 @@ class ServingState:
             raise ValueError("max_new_tokens must be >= 1")
         ids = self.encode(prompt) or [0]      # empty prompt → one pad row
         width = _bucket(len(ids))
-        if width + max_new > self.cfg.max_seq:
+        # lookup mode reserves draft_k cache slots for the transient
+        # chunk writes past the budget (models/speculative.py's span
+        # rule) — reserved uniformly so every request sees one limit
+        head = self.draft_k if self.prompt_lookup else 0
+        if width + max_new + head > self.cfg.max_seq:
             raise ValueError(
                 f"prompt ({len(ids)} tokens, bucket {width}) + "
-                f"max_new_tokens ({max_new}) exceeds max_seq "
-                f"{self.cfg.max_seq}"
+                f"max_new_tokens ({max_new})"
+                + (f" + draft_k ({head})" if head else "")
+                + f" exceeds max_seq {self.cfg.max_seq}"
             )
         run_max_new = min(
             _bucket_max_new(max_new, self.max_new_cap),
-            self.cfg.max_seq - width,
+            self.cfg.max_seq - width - head,
         )
         return ids, max_new, run_max_new, width
 
@@ -336,6 +383,139 @@ class ServingState:
         for i, entry in enumerate(entries):
             entry["tokens"] = tokens[i][:entry["max_new"]].tolist()
 
+    def _ngram_host(self, ctx: list, last: int) -> list:
+        """Latest-occurrence n-gram proposal over the host-side context
+        (prompt + emitted, real tokens only — pads never pollute it).
+        Proposals only set the SPEED of the lookup loop, never its
+        tokens: verification keeps exactly the target's greedy choices,
+        so a bad guess costs a round, not correctness."""
+        n, k = self.ngram, self.draft_k
+        if len(ctx) > n:
+            tail = ctx[-n:]
+            for start in range(len(ctx) - n - 1, -1, -1):
+                if ctx[start:start + n] == tail:
+                    cont = ctx[start + n:start + n + k]
+                    return cont + [last] * (k - len(cont))
+        return [last] * k
+
+    def _lookup_rounds(self, ids: list, width: int, run_max_new: int,
+                       max_new: int):
+        """Prompt-lookup speculation as a host-driven loop (so streaming
+        can surface tokens per ROUND instead of per generation): jitted
+        bucketed prefill, then per round one jitted (draft_k+1)-token
+        ragged decode_chunk whose argmaxes verify the host's n-gram
+        proposals. Yields each round's newly accepted tokens; stops at
+        ``max_new`` or EOS. Cache rollback is O(1) — rewind ``length``,
+        stale slots are masked (models/speculative.py's invariant).
+        Caller holds the generation lock."""
+        jax = self._jax
+        import functools
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_kubernetes.models.decode import decode_chunk, prefill
+
+        cfg = self.cfg
+        k = self.draft_k
+        span = width + run_max_new + k
+        pf = self._cached_program(
+            ("prefill", span),
+            lambda: jax.jit(functools.partial(
+                prefill, cfg=cfg, max_seq=span, kv_quant=self.kv_quant,
+            )),
+        )
+
+        def _build_chunk():
+            def _chunk(params, cache, chunk):
+                logits, cache = decode_chunk(params, cache, chunk[None], cfg)
+                return (
+                    jnp.argmax(logits[0], axis=-1).astype(jnp.int32), cache
+                )
+
+            return jax.jit(_chunk)
+
+        ck = self._cached_program(("lookup_chunk", k), _build_chunk)
+
+        padded = self._pad_rows([ids], width)
+        logits, cache = pf(
+            self.params, jnp.asarray(padded),
+            lengths=jnp.asarray([len(ids)], jnp.int32),
+        )
+        last = int(np.argmax(np.asarray(logits)[0]))
+        emitted = [last]
+        ctx = list(ids) + [last]
+        rounds = drafted = accepted = 0
+        try:
+            done = self.eos_id is not None and last == self.eos_id
+            yield [] if done else [last]          # EOS itself is not emitted
+            while not done and len(emitted) < max_new:
+                drafts = self._ngram_host(ctx, last)
+                greedy, cache = ck(
+                    self.params, cache,
+                    jnp.asarray([last] + drafts, jnp.int32),
+                )
+                g = np.asarray(greedy).tolist()              # k+1 tokens
+                j = 0
+                while j < k and drafts[j] == g[j]:
+                    j += 1
+                n_emit = min(j + 1, max_new - len(emitted))
+                new = g[:n_emit]
+                emitted += new
+                ctx += new
+                last = new[-1]
+                rounds += 1
+                drafted += k
+                accepted += min(j, n_emit)
+                # rewind to "everything before the new last token":
+                # emitted token t sits at slot width + t
+                cache = cache._replace(
+                    length=jnp.asarray(width + len(emitted) - 1, jnp.int32)
+                )
+                if self.eos_id is not None and self.eos_id in new:
+                    new = new[:new.index(self.eos_id)]
+                    done = True
+                yield new
+        finally:
+            # finally: a streaming disconnect closes this generator at a
+            # yield — the work done must still reach the totals
+            self.spec_totals["rounds"] += rounds + 1   # +1: the prefill
+            self.spec_totals["drafted"] += drafted
+            self.spec_totals["accepted"] += accepted
+            self._last_spec = {
+                "rounds": rounds + 1, "drafted": drafted,
+                "accepted": accepted,
+            }
+
+    def _safe_deltas(self, token_batches):
+        """Token batches → UTF-8-safe text deltas (ONE implementation
+        for every streaming mode, so the holdback rule cannot diverge):
+        a trailing U+FFFD is usually an INCOMPLETE multi-byte sequence —
+        the next token completes the character and changes what it
+        decodes to — so it is held back until it either resolves or
+        stops being the tail; the final text flushes at the end."""
+        emitted: list[int] = []
+        sent = ""
+        for new in token_batches:
+            if not new:
+                continue
+            emitted.extend(new)
+            text = self.decode_text(emitted)
+            stable = text[:-1] if text.endswith("�") else text
+            if stable.startswith(sent) and len(stable) > len(sent):
+                yield stable[len(sent):]
+                sent = stable
+        final = self.decode_text(emitted)
+        if final.startswith(sent) and len(final) > len(sent):
+            yield final[len(sent):]            # flush any held-back tail
+
+    def _stream_lookup(self, ids, width, run_max_new, max_new):
+        """Stream the lookup loop's rounds as UTF-8-safe text deltas."""
+        with self._lock:
+            yield from self._safe_deltas(
+                self._lookup_rounds(ids, width, run_max_new, max_new)
+            )
+
     def complete(self, prompt: str, max_new_tokens: int | None = None,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 0.0, seed: int = 0) -> dict:
@@ -351,7 +531,18 @@ class ServingState:
             float(temperature) == 0.0 and int(top_k) == 0
             and float(top_p) == 0.0
         )
-        if self._batcher is not None and greedy_default:
+        spec = None
+        if self.prompt_lookup and greedy_default:
+            # draft-free speculation: tokens are exactly the greedy
+            # decode at this cache span, EOS-trimmed by the loop
+            with self._lock:
+                tokens = [
+                    t for new in self._lookup_rounds(
+                        ids, width, run_max_new, max_new
+                    ) for t in new
+                ]
+                spec = self._last_spec
+        elif self._batcher is not None and greedy_default:
             # greedy rows coalesce without changing output, by the
             # ragged-row identity (up to the documented cache-span
             # float-tie caveat — the batch runs at the co-riders' span)
@@ -369,11 +560,16 @@ class ServingState:
         tokens = tokens[:max_new]              # bucketed run → requested budget
         if self.eos_id is not None and self.eos_id in tokens:
             tokens = tokens[:tokens.index(self.eos_id)]
-        return {
+        result = {
             "text": self.decode_text(tokens),
             "tokens": len(tokens),
             "model": self.model_name,
         }
+        if spec is not None:
+            # per-request speculation telemetry (the acceptance rate IS
+            # the speedup signal: tokens-per-target-pass)
+            result["spec"] = spec
+        return result
 
     def stream(self, prompt: str, max_new_tokens: int | None = None,
                temperature: float = 0.0, top_k: int = 0,
@@ -394,6 +590,16 @@ class ServingState:
         ids, max_new, run_max_new, width = self._validate(
             prompt, max_new_tokens
         )
+        greedy_default = (
+            float(temperature) == 0.0 and int(top_k) == 0
+            and float(top_p) == 0.0
+        )
+        if self.prompt_lookup and greedy_default:
+            # speculation composes with streaming because the loop is
+            # host-driven: whole ROUNDS of tokens surface at once (better
+            # than per-token pacing when proposals are accepted)
+            yield from self._stream_lookup(ids, width, run_max_new, max_new)
+            return
         padded = self._pad_rows([ids], width)
         cfg = self.cfg
 
@@ -435,9 +641,7 @@ class ServingState:
             jax.random.split(rng, run_max_new - 1)
             if run_max_new > 1 else None
         )
-        emitted: list[int] = []
-        sent = ""
-        with self._lock:
+        def tokens():
             logits, cache = pf(
                 self.params, jnp.asarray(padded),
                 lengths=jnp.asarray([len(ids)], jnp.int32),
@@ -449,23 +653,14 @@ class ServingState:
             for i in range(max_new):
                 t = int(np.asarray(tok)[0])
                 if self.eos_id is not None and t == self.eos_id:
-                    break
-                emitted.append(t)
-                text = self.decode_text(emitted)
-                # a trailing U+FFFD is usually an INCOMPLETE multi-byte
-                # sequence (the next token completes the character and
-                # changes what it decodes to) — hold it back until it
-                # either resolves or stops being the tail
-                stable = text[:-1] if text.endswith("�") else text
-                if stable.startswith(sent) and len(stable) > len(sent):
-                    yield stable[len(sent):]
-                    sent = stable
-                if len(emitted) == max_new:
-                    break
+                    return
+                yield [t]
+                if i + 1 == max_new:
+                    return
                 tok, cache = step(self.params, cache, tok, step_rngs[i])
-        final = self.decode_text(emitted)
-        if final.startswith(sent) and len(final) > len(sent):
-            yield final[len(sent):]            # flush any held-back tail
+
+        with self._lock:
+            yield from self._safe_deltas(tokens())
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -495,12 +690,20 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(404, {"error": "unknown path"})
         if not st.ready:
             return self._json(503, {"status": "warming"})
-        return self._json(200, {
+        body = {
             "status": "ok",
             "model": st.model_name,
             "max_new_tokens_cap": st.max_new_cap,
             "kv_quant": st.kv_quant,
-        })
+        }
+        if st.prompt_lookup:
+            t = st.spec_totals
+            body["prompt_lookup"] = {
+                "draft_k": st.draft_k, "ngram": st.ngram,
+                "drafted": t["drafted"], "accepted": t["accepted"],
+                "rounds": t["rounds"],
+            }
+        return self._json(200, body)
 
     def do_POST(self):  # noqa: N802
         if self.path != "/v1/completions":
