@@ -323,7 +323,7 @@ class TestRepairAuto:
         backend = _cluster(tmp_path, ex)
         server.nodes["10-0-0-41"] = make_node("10-0-0-41", ready=False)
         server.nodes["10-0-0-42"] = make_node("10-0-0-42")
-        keys = self._repair(backend, ex)
+        keys = self._repair(backend, ex, {"replace_nodes": True})
         # only the dead node's module is destroyed + re-applied
         [destroy_call] = [c for c in ex.calls if c.command == "destroy"]
         assert destroy_call.targets == (
@@ -341,7 +341,7 @@ class TestRepairAuto:
         ex = _fleet_executor(url)
         backend = _cluster(tmp_path, ex)
         server.nodes["10-0-0-42"] = make_node("10-0-0-42")
-        self._repair(backend, ex)
+        self._repair(backend, ex, {"replace_nodes": True})
         [destroy_call] = [c for c in ex.calls if c.command == "destroy"]
         assert destroy_call.targets == (
             "module.node_baremetal_alpha_10-0-0-41",
@@ -373,10 +373,11 @@ class TestRepairAuto:
                 asked.append(question)
                 return True
 
-        # interactive (non_interactive=False): the advisory only computes
-        # when a prompt would actually be shown
+        # interactive (non_interactive=False): the advisory rides the
+        # confirmation question
         cfg = RecordingConfig(values={
             "cluster_manager": "dev", "cluster_name": "alpha", "auto": True,
+            "replace_nodes": True,
         }, non_interactive=False, env={})
         repair_cluster(backend, cfg, ex)
         assert any("2 pod(s) are currently Running" in q for q in asked)
@@ -451,3 +452,92 @@ class TestGetClusterHealth:
             "10-0-0-41": {"10-0-0-41": "Ready"},
             "10-0-0-42": {"10-0-0-42": "NotReady"},
         }
+
+
+class TestRepairAutoSoftTrigger:
+    """--auto alone diagnoses and reports (VERDICT r04 Weak #4: detection
+    must not auto-escalate to destruction); --replace_nodes acts; --grace
+    spares transient NotReady blips."""
+
+    def _repair(self, backend, ex, extra=None):
+        from tpu_kubernetes.repair import repair_cluster
+
+        return repair_cluster(backend, _cfg({
+            "cluster_manager": "dev", "cluster_name": "alpha",
+            "auto": True, **(extra or {}),
+        }), ex)
+
+    def test_auto_alone_reports_and_exits_nonzero(self, kube, tmp_path):
+        server, url = kube
+        ex = _fleet_executor(url)
+        backend = _cluster(tmp_path, ex)
+        server.nodes["10-0-0-41"] = make_node("10-0-0-41", ready=False)
+        server.nodes["10-0-0-42"] = make_node("10-0-0-42")
+        with pytest.raises(ProviderError, match="--replace_nodes"):
+            self._repair(backend, ex)
+        # nothing destroyed, the ghost Node object untouched
+        assert [c for c in ex.calls if c.command == "destroy"] == []
+        assert "10-0-0-41" in server.nodes
+
+    def test_grace_spares_a_transient_notready(self, kube, tmp_path,
+                                               capsys, monkeypatch):
+        """A node that recovers inside the grace window is NOT destroyed —
+        the kubelet-restart-blip scenario."""
+        server, url = kube
+        ex = _fleet_executor(url)
+        backend = _cluster(tmp_path, ex)
+        server.nodes["10-0-0-41"] = make_node("10-0-0-41", ready=False)
+        server.nodes["10-0-0-42"] = make_node("10-0-0-42")
+
+        import tpu_kubernetes.repair as repair_mod
+
+        def recover(seconds):
+            assert seconds == 30
+            server.nodes["10-0-0-41"] = make_node("10-0-0-41")
+
+        monkeypatch.setattr(repair_mod.time, "sleep", recover)
+        keys = self._repair(
+            backend, ex, {"replace_nodes": True, "grace": 30}
+        )
+        assert keys == []
+        assert [c for c in ex.calls if c.command == "destroy"] == []
+        out = capsys.readouterr().out
+        assert "recovered within grace" in out
+        assert "all nodes Ready" in out
+
+    def test_grace_still_replaces_a_persistent_failure(self, kube, tmp_path,
+                                                       monkeypatch):
+        server, url = kube
+        ex = _fleet_executor(url)
+        backend = _cluster(tmp_path, ex)
+        server.nodes["10-0-0-41"] = make_node("10-0-0-41", ready=False)
+        server.nodes["10-0-0-42"] = make_node("10-0-0-42")
+
+        import tpu_kubernetes.repair as repair_mod
+
+        monkeypatch.setattr(repair_mod.time, "sleep", lambda s: None)
+        keys = self._repair(
+            backend, ex, {"replace_nodes": True, "grace": 30}
+        )
+        [destroy_call] = [c for c in ex.calls if c.command == "destroy"]
+        assert destroy_call.targets == (
+            "module.node_baremetal_alpha_10-0-0-41",
+        )
+        assert "node_baremetal_alpha_10-0-0-41" in keys
+
+    def test_pod_advisory_prints_even_non_interactive(self, kube, tmp_path,
+                                                      capsys):
+        """The running-pod advisory is computed whenever the fleet API can
+        answer — force/non-interactive runs see it as a printed line."""
+        server, url = kube
+        ex = _fleet_executor(url)
+        backend = _cluster(tmp_path, ex)
+        server.nodes["10-0-0-41"] = make_node("10-0-0-41", ready=False)
+        server.nodes["10-0-0-42"] = make_node("10-0-0-42")
+        server.pods = [{
+            "metadata": {"namespace": "default", "name": "job-0"},
+            "spec": {"nodeName": "10-0-0-41"},
+            "status": {"phase": "Running"},
+        }]
+        self._repair(backend, ex, {"replace_nodes": True})
+        assert "1 pod(s) are currently Running" in capsys.readouterr().out

@@ -83,6 +83,22 @@ def build_parser() -> argparse.ArgumentParser:
              "(no reference analog)",
     )
     repair.add_argument("kind", choices=["cluster"])
+    repair.add_argument(
+        "--auto", action="store_true",
+        help="diagnose node health via the manager and report; exits "
+             "nonzero when nodes are unhealthy (add --replace_nodes to act)",
+    )
+    repair.add_argument(
+        "--replace_nodes", action="store_true",
+        help="destroy + re-create node modules (with --auto: exactly the "
+             "diagnosed-unhealthy ones)",
+    )
+    repair.add_argument(
+        "--grace", type=int, metavar="SECONDS",
+        help="with --auto: re-check after this many seconds and spare "
+             "nodes that recover (a kubelet restart shows as a NotReady "
+             "blip)",
+    )
 
     sub.add_parser("version", help="print the version")
     return parser
@@ -96,6 +112,18 @@ def main(argv: list[str] | None = None) -> int:
         # reference: cmd/version.go:13-26
         print(f"tpu-kubernetes v{tpu_kubernetes.__version__}")
         return 0
+
+    if (args.command == "repair" and args.grace is not None
+            and not args.auto):
+        # the grace re-check only exists on the diagnosis path; silently
+        # ignoring it before a replace-all would be exactly the footgun
+        # it guards against. Checked before any prompting.
+        print(
+            "error: --grace requires --auto (the re-check spares "
+            "diagnosed-unhealthy nodes that recover)",
+            file=sys.stderr,
+        )
+        return 2
 
     cfg = Config.load(args.config, non_interactive=args.non_interactive)
     for item in args.set:
@@ -126,6 +154,14 @@ def main(argv: list[str] | None = None) -> int:
                 destroy_wf.delete_node(backend, cfg, executor)
         elif args.command == "repair":
             log.info("repairing cluster")
+            # argparse flags are sugar over the config keys (YAML/--set
+            # spellings keep working)
+            if args.auto:
+                cfg.set("auto", "true")
+            if args.replace_nodes:
+                cfg.set("replace_nodes", "true")
+            if args.grace is not None:
+                cfg.set("grace", str(args.grace))
             keys = repair_wf.repair_cluster(backend, cfg, executor)
             if keys:
                 print(f"Repaired {len(keys)} module(s).")

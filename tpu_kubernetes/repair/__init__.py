@@ -15,8 +15,12 @@ re-created as a whole. This workflow re-applies a cluster's module set:
   preemption leaves the resource visible, so refresh alone won't replace it).
 * ``auto`` — the user stops being the failure detector (round-3 VERDICT
   Missing #2): ask the manager's kube API about every Node the cluster
-  should have (fleet/nodes.py), print the diagnosis, and replace exactly
-  the node modules with a missing/NotReady member. All healthy → no-op.
+  should have (fleet/nodes.py) and print the diagnosis. All healthy →
+  no-op. Unhealthy → **diagnose-and-report by default** (nonzero exit so
+  monitors can alert); destroying machines takes the explicit
+  ``auto + replace_nodes`` combination, which replaces exactly the
+  unhealthy modules. ``grace`` re-checks after a sleep and spares nodes
+  that recover — a transient kubelet restart must not cost a machine.
   The manager being unreachable fails the repair loudly — guessing a
   replace set without data would destroy healthy machines.
 
@@ -29,6 +33,8 @@ Holds the backend lock across the whole window, like every other mutation.
 """
 
 from __future__ import annotations
+
+import time
 
 from tpu_kubernetes.backend import Backend
 from tpu_kubernetes.config import Config
@@ -91,12 +97,36 @@ def repair_cluster(backend: Backend, cfg: Config, executor: Executor) -> list[st
         if auto:
             bad_hosts = _auto_diagnose(fleet_api, state, cluster_key)
             run_info["diagnosed_unhealthy"] = bad_hosts
+            grace = cfg.get_int("grace", default=0)
+            if bad_hosts and grace > 0:
+                # a transient kubelet restart shows as a NotReady blip;
+                # only nodes unhealthy across the whole window are acted on
+                print(
+                    f"{cluster_key}: {len(bad_hosts)} unhealthy — "
+                    f"re-checking after a {grace}s grace window"
+                )
+                time.sleep(grace)
+                second = set(
+                    _auto_diagnose(fleet_api, state, cluster_key)
+                )
+                for h in sorted(set(bad_hosts) - second):
+                    print(f"  {h}: recovered within grace — spared")
+                bad_hosts = [h for h in bad_hosts if h in second]
+                run_info["unhealthy_after_grace"] = bad_hosts
             if not bad_hosts:
                 print(f"{cluster_key}: all nodes Ready — nothing to repair")
                 return []
-            # a detected-dead machine is STOPPED-but-present more often than
-            # deleted (GCE/TPU preemption), so --auto implies replacement
-            replace = True
+            if not replace:
+                # detection must not imply destruction: --auto alone is
+                # diagnose-and-report (nonzero exit so monitors can alert);
+                # destroying machines takes the explicit --replace_nodes
+                raise ProviderError(
+                    f"{cluster_key}: {len(bad_hosts)} unhealthy node(s): "
+                    f"{', '.join(sorted(bad_hosts))} — re-run with "
+                    "`repair cluster --auto --replace_nodes` to replace "
+                    "exactly these (add --grace <seconds> to spare "
+                    "transient NotReady blips)"
+                )
             replace_hosts = bad_hosts
         else:
             replace_hosts = sorted(nodes)
@@ -105,15 +135,15 @@ def repair_cluster(backend: Backend, cfg: Config, executor: Executor) -> list[st
         if replace:
             # advisory: what is actually RUNNING on the doomed machines
             # (round-3 VERDICT Weak #5 — one confirm covered dead and live
-            # nodes alike). Only computed when a prompt will actually show
-            # (force/non-interactive answer yes without reading it), and
-            # 'could not check' keeps the generic warning — it must never
-            # read as 'verified idle'.
+            # nodes alike). Computed whenever the fleet API can answer —
+            # force/non-interactive runs still get it as a printed line —
+            # and 'could not check' keeps the generic warning: it must
+            # never read as 'verified idle'.
             will_prompt = not (
                 cfg.get_bool("force", default=False) or cfg.non_interactive
             )
             pod_note = " Make sure no job you care about is running on them."
-            if will_prompt and fleet_api is not None:
+            if fleet_api is not None:
                 expected = expected_node_names(state, cluster_key)
                 counts = [
                     count_running_pods_on(fleet_api, name)
@@ -127,6 +157,9 @@ def repair_cluster(backend: Backend, cfg: Config, executor: Executor) -> list[st
                         "and will be killed." if n_pods
                         else " No running pods on them."
                     )
+            if not will_prompt:
+                print(f"{cluster_key}: replacing {len(node_keys)} node "
+                      f"module(s).{pod_note}")
             question = (
                 f"Replace the nodes of cluster {cluster_key} "
                 f"({len(node_keys)} node module(s))? This DESTROYS those "

@@ -835,7 +835,12 @@ def main() -> int:
             f"{denv.num_processes}); use `python -m tpu_kubernetes.serve."
             f"job` for multi-host slice serving"
         )
-    server = make_server()
+    try:
+        server = make_server()
+    except ValueError as e:
+        # config rejections (lookup × MoE/KV-quant/batch, bad knobs) are
+        # one-line diagnostics, not tracebacks — the batch job's stance
+        raise SystemExit(f"config error: {e}") from e
     host, port = server.server_address[:2]
     log(f"listening on {host}:{port}")
     try:
