@@ -541,3 +541,16 @@ class TestRepairAutoSoftTrigger:
         }]
         self._repair(backend, ex, {"replace_nodes": True})
         assert "1 pod(s) are currently Running" in capsys.readouterr().out
+
+    def test_grace_without_auto_is_a_loud_error(self, kube, tmp_path):
+        server, url = kube
+        ex = _fleet_executor(url)
+        backend = _cluster(tmp_path, ex)
+        from tpu_kubernetes.repair import repair_cluster
+
+        with pytest.raises(ProviderError, match="grace requires auto"):
+            repair_cluster(backend, _cfg({
+                "cluster_manager": "dev", "cluster_name": "alpha",
+                "replace_nodes": True, "grace": 60,
+            }), ex)
+        assert [c for c in ex.calls if c.command == "destroy"] == []

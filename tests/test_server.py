@@ -203,6 +203,25 @@ def test_bad_requests_rejected(server):
     assert status == 400
 
 
+def _read_sse(resp):
+    """Parse an SSE body: returns (joined text pieces, saw_done,
+    content_type)."""
+    ctype = resp.getheader("Content-Type", "")
+    raw = resp.read().decode("utf-8")
+    pieces, done = [], False
+    for line in raw.splitlines():
+        if not line.startswith("data: "):
+            continue
+        payload = line[len("data: "):]
+        if payload == "[DONE]":
+            done = True
+            continue
+        obj = json.loads(payload)
+        choice = obj["choices"][0]
+        pieces.append(choice.get("text") or choice.get("delta", {}).get("content", ""))
+    return "".join(pieces), done, ctype
+
+
 def test_streaming_matches_non_streamed_greedy(server):
     """stream=true delivers a chunked response whose concatenation is
     the non-streamed greedy text (same cache span, same math)."""
@@ -219,8 +238,10 @@ def test_streaming_matches_non_streamed_greedy(server):
     resp = conn.getresponse()
     assert resp.status == 200
     assert resp.chunked                      # genuinely streamed
-    text = resp.read().decode("utf-8")
+    text, done, ctype = _read_sse(resp)
     conn.close()
+    assert ctype.startswith("text/event-stream")
+    assert done                              # terminal data: [DONE]
     assert text == plain["text"]
 
 
@@ -238,8 +259,9 @@ def test_streaming_sampled_matches_non_streamed_seed(server):
         headers={"Content-Type": "application/json"},
     )
     resp = conn.getresponse()
-    text = resp.read().decode("utf-8")
+    text, done, _ = _read_sse(resp)
     conn.close()
+    assert done
     assert text == plain["text"]
 
 
@@ -323,8 +345,9 @@ def test_lookup_streaming_matches_non_streamed(lookup_server):
     )
     resp = conn.getresponse()
     assert resp.status == 200
-    text = resp.read().decode("utf-8")
+    text, done, _ = _read_sse(resp)
     conn.close()
+    assert done
     assert text == plain["text"]
 
 
@@ -353,3 +376,74 @@ def test_lookup_config_rejections():
         make_server(dict(
             ENV, SERVE_PROMPT_LOOKUP="1", SERVE_MODEL="moe-test",
         ))
+
+
+# -- OpenAI-compat: /v1/chat/completions ------------------------------------
+
+def test_chat_completion_round_trip(server):
+    """The chat endpoint renders messages as a role-prefixed transcript
+    and answers OpenAI-shaped; its content must equal /v1/completions on
+    the same rendered prompt."""
+    messages = [
+        {"role": "system", "content": "be brief"},
+        {"role": "user", "content": "hello tpu"},
+    ]
+    rendered = "system: be brief\nuser: hello tpu\nassistant:"
+    _, plain = _request(
+        server, "POST", "/v1/completions",
+        {"prompt": rendered, "max_new_tokens": 6},
+    )
+    status, chat = _request(
+        server, "POST", "/v1/chat/completions",
+        {"messages": messages, "max_tokens": 6},
+    )
+    assert status == 200
+    assert chat["object"] == "chat.completion"
+    choice = chat["choices"][0]
+    assert choice["message"]["role"] == "assistant"
+    assert choice["message"]["content"] == plain["text"]
+    # no EOS configured and the full budget was generated → "length"
+    assert choice["finish_reason"] == "length"
+    assert chat["usage"]["completion_tokens"] == plain["tokens"]
+
+
+def test_chat_streaming_sse_deltas(server):
+    """Chat streaming sends chat.completion.chunk deltas whose
+    concatenation equals the non-streamed chat content, closed by
+    data: [DONE] — what an OpenAI streaming client parses."""
+    messages = [{"role": "user", "content": "stream chat"}]
+    _, plain = _request(
+        server, "POST", "/v1/chat/completions",
+        {"messages": messages, "max_tokens": 6},
+    )
+    host, port = server.server_address[:2]
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request(
+        "POST", "/v1/chat/completions",
+        body=json.dumps(
+            {"messages": messages, "max_tokens": 6, "stream": True}
+        ),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    assert resp.status == 200
+    text, done, ctype = _read_sse(resp)
+    conn.close()
+    assert ctype.startswith("text/event-stream")
+    assert done
+    assert text == plain["choices"][0]["message"]["content"]
+
+
+def test_chat_bad_requests_rejected(server):
+    for bad in (
+        {},                                          # no messages
+        {"messages": []},                            # empty
+        {"messages": [{"role": "robot", "content": "x"}]},   # bad role
+        {"messages": [{"role": "user"}]},            # no content
+        {"messages": "hi"},                          # wrong type
+    ):
+        status, data = _request(
+            server, "POST", "/v1/chat/completions", bad
+        )
+        assert status == 400, bad
+        assert "error" in data

@@ -113,18 +113,6 @@ def main(argv: list[str] | None = None) -> int:
         print(f"tpu-kubernetes v{tpu_kubernetes.__version__}")
         return 0
 
-    if (args.command == "repair" and args.grace is not None
-            and not args.auto):
-        # the grace re-check only exists on the diagnosis path; silently
-        # ignoring it before a replace-all would be exactly the footgun
-        # it guards against. Checked before any prompting.
-        print(
-            "error: --grace requires --auto (the re-check spares "
-            "diagnosed-unhealthy nodes that recover)",
-            file=sys.stderr,
-        )
-        return 2
-
     cfg = Config.load(args.config, non_interactive=args.non_interactive)
     for item in args.set:
         key, sep, value = item.partition("=")

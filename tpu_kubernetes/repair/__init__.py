@@ -91,13 +91,23 @@ def repair_cluster(backend: Backend, cfg: Config, executor: Executor) -> list[st
         run_info["cluster"] = cluster_key
         replace = cfg.get_bool("replace_nodes", default=False)
         auto = cfg.get_bool("auto", default=False)
+        grace = cfg.get_int("grace", default=0)
+        if grace and not auto:
+            # validated where ALL spellings converge (--grace flag and
+            # --set grace=N alike): the re-check only exists on the
+            # diagnosis path, and silently ignoring it before a
+            # replace-all would be exactly the footgun it guards against
+            raise ProviderError(
+                "grace requires auto (the re-check spares "
+                "diagnosed-unhealthy nodes that recover) — add --auto "
+                "or drop --grace"
+            )
 
         fleet_api = resolve_fleet_api(executor, state, cluster_key)
 
         if auto:
             bad_hosts = _auto_diagnose(fleet_api, state, cluster_key)
             run_info["diagnosed_unhealthy"] = bad_hosts
-            grace = cfg.get_int("grace", default=0)
             if bad_hosts and grace > 0:
                 # a transient kubelet restart shows as a NotReady blip;
                 # only nodes unhealthy across the whole window are acted on

@@ -12,13 +12,21 @@ A minimal stdlib server (zero dependencies, air-gap friendly) exposing:
                               "top_p"?: float, "seed"?: int,
                               "stream"?: bool}
                              ⇒ {"text": str, "tokens": int, "model": str}
-                             — or, with "stream": true, a chunked
-                             text/plain response whose pieces arrive as
+                             — or, with "stream": true, a Server-Sent
+                             Events response (``data: {json}`` frames
+                             with OpenAI-shaped chunks, terminal
+                             ``data: [DONE]``) whose pieces arrive as
                              tokens decode (a per-token decode_step loop
                              instead of the fused generate program;
                              UTF-8-safe: each piece is the delta of the
                              decoded prefix, so multi-byte characters
-                             never split across chunks)
+                             never split across frames)
+  POST /v1/chat/completions→ OpenAI chat shape: {"messages": [{"role",
+                             "content"}...], "max_tokens"?, ...,
+                             "stream"?} ⇒ chat.completion (or SSE
+                             chat.completion.chunk deltas). Messages
+                             render as a plain role-prefixed transcript
+                             (no model-specific template)
 
 Model bring-up reuses the batch job's env contract exactly
 (``load_serving_stack``: SERVE_MODEL / SERVE_HF_CHECKPOINT /
@@ -74,6 +82,7 @@ import os
 import sys
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
@@ -563,6 +572,10 @@ class ServingState:
         result = {
             "text": self.decode_text(tokens),
             "tokens": len(tokens),
+            "prompt_tokens": len(ids),
+            # the budget rule lives HERE (one place): a full budget means
+            # truncation, anything shorter means EOS stopped the row
+            "finish_reason": "length" if len(tokens) >= max_new else "stop",
             "model": self.model_name,
         }
         if spec is not None:
@@ -705,18 +718,57 @@ class _Handler(BaseHTTPRequestHandler):
             }
         return self._json(200, body)
 
+    @staticmethod
+    def _chat_prompt(messages) -> str:
+        """OpenAI-style messages → one prompt string. Deliberately a
+        plain role-prefixed transcript (the byte tokenizer has no chat
+        template; HF-tokenized models see the same canonical text, so
+        responses are reproducible across tokenizers)."""
+        if not isinstance(messages, list) or not messages:
+            raise ValueError('"messages" must be a non-empty list')
+        parts = []
+        for m in messages:
+            if not isinstance(m, dict):
+                raise ValueError("each message must be an object")
+            role = m.get("role")
+            content = m.get("content")
+            if role not in ("system", "user", "assistant"):
+                raise ValueError(f"unknown message role {role!r}")
+            if not isinstance(content, str):
+                raise ValueError('message "content" must be a string')
+            parts.append(f"{role}: {content}")
+        parts.append("assistant:")
+        return "\n".join(parts)
+
     def do_POST(self):  # noqa: N802
-        if self.path != "/v1/completions":
+        chat = self.path == "/v1/chat/completions"
+        if self.path != "/v1/completions" and not chat:
             return self._json(404, {"error": "unknown path"})
         if not self.state.ready:
             return self._json(503, {"error": "warming"})
         try:
             length = int(self.headers.get("Content-Length", "0"))
             body = json.loads(self.rfile.read(length) or b"{}")
-            if not isinstance(body, dict) or "prompt" not in body:
-                raise ValueError('body must be a JSON object with "prompt"')
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            if chat:
+                prompt = self._chat_prompt(body.get("messages"))
+                # OpenAI chat spells the budget "max_tokens"
+                max_new = body.get(
+                    "max_tokens", body.get("max_new_tokens")
+                )
+            else:
+                if "prompt" not in body:
+                    raise ValueError(
+                        'body must be a JSON object with "prompt"'
+                    )
+                prompt = str(body["prompt"])
+                # OpenAI's legacy completions API spells it "max_tokens"
+                max_new = body.get(
+                    "max_new_tokens", body.get("max_tokens")
+                )
             kwargs = dict(
-                max_new_tokens=body.get("max_new_tokens"),
+                max_new_tokens=max_new,
                 temperature=body.get("temperature", 0.0),
                 top_k=body.get("top_k", 0),
                 top_p=body.get("top_p", 0.0),
@@ -725,23 +777,46 @@ class _Handler(BaseHTTPRequestHandler):
             if body.get("stream"):
                 # validate (and pay the first device call) BEFORE the
                 # 200 status goes out — errors must still be a 400
-                pieces = self.state.stream(str(body["prompt"]), **kwargs)
+                pieces = self.state.stream(prompt, **kwargs)
                 first = next(pieces, None)
-                return self._stream_chunked(first, pieces)
-            result = self.state.complete(str(body["prompt"]), **kwargs)
+                return self._stream_sse(first, pieces, chat=chat)
+            result = self.state.complete(prompt, **kwargs)
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             # TypeError covers wrong-typed JSON fields (e.g. top_k: [1])
             # — a malformed request must be a 400, not a dropped socket
             return self._json(400, {"error": str(e)})
+        if chat:
+            return self._json(200, {
+                "id": f"chatcmpl-{uuid.uuid4().hex}",
+                "object": "chat.completion",
+                "created": int(time.time()),
+                "model": result["model"],
+                "choices": [{
+                    "index": 0,
+                    "message": {
+                        "role": "assistant", "content": result["text"],
+                    },
+                    "finish_reason": result["finish_reason"],
+                }],
+                "usage": {
+                    "prompt_tokens": result["prompt_tokens"],
+                    "completion_tokens": result["tokens"],
+                    "total_tokens": (
+                        result["prompt_tokens"] + result["tokens"]
+                    ),
+                },
+            })
         return self._json(200, result)
 
-    def _stream_chunked(self, first: str | None, pieces) -> None:
-        """Write chunked pieces WITHOUT coupling the chip to the client:
-        a producer thread drains the generator (which holds the
-        generation lock) into an unbounded queue at chip speed — total
-        work is bounded by max_new_tokens — while this thread writes at
-        whatever pace the client reads. A slow or dead reader can never
-        hold the generation lock hostage."""
+    def _stream_sse(self, first: str | None, pieces, chat: bool) -> None:
+        """Write text pieces as Server-Sent Events (``data: {json}``
+        frames, terminal ``data: [DONE]`` — what OpenAI streaming
+        clients parse) WITHOUT coupling the chip to the client: a
+        producer thread drains the generator (which holds the generation
+        lock) into an unbounded queue at chip speed — total work is
+        bounded by max_new_tokens — while this thread writes at whatever
+        pace the client reads. A slow or dead reader can never hold the
+        generation lock hostage."""
         import queue
 
         q: queue.Queue = queue.Queue()
@@ -758,33 +833,59 @@ class _Handler(BaseHTTPRequestHandler):
                 q.put(_FAILED)
 
         producer = None
+        sid = (
+            f"chatcmpl-{uuid.uuid4().hex}" if chat
+            else f"cmpl-{uuid.uuid4().hex}"
+        )
+        created = int(time.time())
         try:
             # header writes are INSIDE the disconnect handler: a client
             # gone before the status line still suspends the stream()
             # generator inside the generation lock, and only the finally
             # below releases it deterministically
             self.send_response(200)
-            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
             failed = False
             if first is not None:
                 producer = threading.Thread(target=produce, daemon=True)
                 producer.start()
-                self._write_chunk(first)
+                self._write_sse(first, chat, sid, created)
                 while (piece := q.get()) is not None:
                     if piece is _FAILED:
                         failed = True
                         break
-                    self._write_chunk(piece)
+                    self._write_sse(piece, chat, sid, created)
             if failed:
-                # NO terminal chunk: aborting the chunked body is the
-                # in-band error signal — a clean EOF would make a
-                # truncated completion look like a successful one
+                # NO [DONE] and NO terminal chunk: aborting the chunked
+                # body is the in-band error signal — a clean EOF would
+                # make a truncated completion look like a successful one
                 log("aborting stream after mid-generation failure")
                 self.close_connection = True
                 self.wfile.flush()
             else:
+                # the closing frame OpenAI streaming clients expect: an
+                # empty delta carrying finish_reason, then [DONE]. The
+                # stream surface carries text (not token counts), so the
+                # reason is the generic "stop".
+                final_choice = (
+                    {"index": 0, "delta": {}, "finish_reason": "stop"}
+                    if chat else
+                    {"index": 0, "text": "", "finish_reason": "stop"}
+                )
+                self._write_raw(("data: " + json.dumps({
+                    "id": sid,
+                    "object": (
+                        "chat.completion.chunk" if chat
+                        else "text_completion"
+                    ),
+                    "created": created,
+                    "model": self.state.model_name,
+                    "choices": [final_choice],
+                }) + "\n\n").encode("utf-8"))
+                self._write_raw(b"data: [DONE]\n\n")
                 self.wfile.write(b"0\r\n\r\n")
         except (BrokenPipeError, ConnectionResetError):
             # client went away mid-stream; the producer finishes its
@@ -800,11 +901,31 @@ class _Handler(BaseHTTPRequestHandler):
                 # at GC time
                 pieces.close()
 
-    def _write_chunk(self, piece: str) -> None:
-        data = piece.encode("utf-8")
-        if data:
-            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
-            self.wfile.flush()
+    def _write_sse(self, piece: str, chat: bool, sid: str,
+                   created: int) -> None:
+        if not piece:
+            return
+        st = self.state
+        if chat:
+            choice = {
+                "index": 0, "delta": {"content": piece},
+                "finish_reason": None,
+            }
+        else:
+            choice = {"index": 0, "text": piece, "finish_reason": None}
+        obj = {
+            "id": sid,
+            "object": "chat.completion.chunk" if chat else "text_completion",
+            "created": created,
+            "model": st.model_name,
+            "choices": [choice],
+        }
+        self._write_raw(f"data: {json.dumps(obj)}\n\n".encode("utf-8"))
+
+    def _write_raw(self, data: bytes) -> None:
+        """One HTTP/1.1 chunk carrying one SSE frame."""
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
 
 
 def make_server(env: dict | None = None) -> ThreadingHTTPServer:
