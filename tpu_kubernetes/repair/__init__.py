@@ -91,12 +91,15 @@ def repair_cluster(backend: Backend, cfg: Config, executor: Executor) -> list[st
         run_info["cluster"] = cluster_key
         replace = cfg.get_bool("replace_nodes", default=False)
         auto = cfg.get_bool("auto", default=False)
-        grace = cfg.get_int("grace", default=0)
-        if grace and not auto:
+        grace_set = cfg.get("grace", default=None) is not None
+        grace = cfg.get_int("grace", default=0) if grace_set else 0
+        if grace_set and not auto:
             # validated where ALL spellings converge (--grace flag and
-            # --set grace=N alike): the re-check only exists on the
-            # diagnosis path, and silently ignoring it before a
-            # replace-all would be exactly the footgun it guards against
+            # --set grace=N alike), and on SET-ness, not value — a
+            # computed --grace 0 without --auto is the same misuse: the
+            # re-check only exists on the diagnosis path, and silently
+            # ignoring it before a replace-all would be exactly the
+            # footgun it guards against
             raise ProviderError(
                 "grace requires auto (the re-check spares "
                 "diagnosed-unhealthy nodes that recover) — add --auto "

@@ -10,8 +10,10 @@ A minimal stdlib server (zero dependencies, air-gap friendly) exposing:
   POST /v1/completions     → {"prompt": str, "max_new_tokens"?: int,
                               "temperature"?: float, "top_k"?: int,
                               "top_p"?: float, "seed"?: int,
-                              "stream"?: bool}
-                             ⇒ {"text": str, "tokens": int, "model": str}
+                              "stream"?: bool}  ("max_tokens" aliases)
+                             ⇒ {"text": str, "tokens": int,
+                              "prompt_tokens": int, "finish_reason":
+                              "stop"|"length", "model": str}
                              — or, with "stream": true, a Server-Sent
                              Events response (``data: {json}`` frames
                              with OpenAI-shaped chunks, terminal
@@ -408,7 +410,7 @@ class ServingState:
         return [last] * k
 
     def _lookup_rounds(self, ids: list, width: int, run_max_new: int,
-                       max_new: int):
+                       max_new: int, finish: dict | None = None):
         """Prompt-lookup speculation as a host-driven loop (so streaming
         can surface tokens per ROUND instead of per generation): jitted
         bucketed prefill, then per round one jitted (draft_k+1)-token
@@ -485,6 +487,8 @@ class ServingState:
                     new = new[:new.index(self.eos_id)]
                     done = True
                 yield new
+            if finish is not None:
+                finish["reason"] = "stop" if done else "length"
         finally:
             # finally: a streaming disconnect closes this generator at a
             # yield — the work done must still reach the totals
@@ -518,11 +522,14 @@ class ServingState:
         if final.startswith(sent) and len(final) > len(sent):
             yield final[len(sent):]            # flush any held-back tail
 
-    def _stream_lookup(self, ids, width, run_max_new, max_new):
+    def _stream_lookup(self, ids, width, run_max_new, max_new,
+                       finish: dict | None = None):
         """Stream the lookup loop's rounds as UTF-8-safe text deltas."""
         with self._lock:
             yield from self._safe_deltas(
-                self._lookup_rounds(ids, width, run_max_new, max_new)
+                self._lookup_rounds(
+                    ids, width, run_max_new, max_new, finish
+                )
             )
 
     def complete(self, prompt: str, max_new_tokens: int | None = None,
@@ -586,7 +593,8 @@ class ServingState:
 
     def stream(self, prompt: str, max_new_tokens: int | None = None,
                temperature: float = 0.0, top_k: int = 0,
-               top_p: float = 0.0, seed: int = 0):
+               top_p: float = 0.0, seed: int = 0,
+               finish: dict | None = None):
         """Yield text pieces as tokens decode: prefill once, then a
         per-token jitted decode_step+sample loop (the fused generate
         cannot surface tokens before the scan finishes). Each piece is
@@ -611,7 +619,9 @@ class ServingState:
             # speculation composes with streaming because the loop is
             # host-driven: whole ROUNDS of tokens surface at once (better
             # than per-token pacing when proposals are accepted)
-            yield from self._stream_lookup(ids, width, run_max_new, max_new)
+            yield from self._stream_lookup(
+                ids, width, run_max_new, max_new, finish
+            )
             return
         padded = self._pad_rows([ids], width)
         cfg = self.cfg
@@ -666,9 +676,13 @@ class ServingState:
             for i in range(max_new):
                 t = int(np.asarray(tok)[0])
                 if self.eos_id is not None and t == self.eos_id:
+                    if finish is not None:
+                        finish["reason"] = "stop"
                     return
                 yield [t]
                 if i + 1 == max_new:
+                    if finish is not None:
+                        finish["reason"] = "length"
                     return
                 tok, cache = step(self.params, cache, tok, step_rngs[i])
 
@@ -777,9 +791,12 @@ class _Handler(BaseHTTPRequestHandler):
             if body.get("stream"):
                 # validate (and pay the first device call) BEFORE the
                 # 200 status goes out — errors must still be a 400
-                pieces = self.state.stream(prompt, **kwargs)
+                finish: dict = {}
+                pieces = self.state.stream(prompt, finish=finish, **kwargs)
                 first = next(pieces, None)
-                return self._stream_sse(first, pieces, chat=chat)
+                return self._stream_sse(
+                    first, pieces, chat=chat, finish=finish
+                )
             result = self.state.complete(prompt, **kwargs)
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             # TypeError covers wrong-typed JSON fields (e.g. top_k: [1])
@@ -808,7 +825,8 @@ class _Handler(BaseHTTPRequestHandler):
             })
         return self._json(200, result)
 
-    def _stream_sse(self, first: str | None, pieces, chat: bool) -> None:
+    def _stream_sse(self, first: str | None, pieces, chat: bool,
+                    finish: dict | None = None) -> None:
         """Write text pieces as Server-Sent Events (``data: {json}``
         frames, terminal ``data: [DONE]`` — what OpenAI streaming
         clients parse) WITHOUT coupling the chip to the client: a
@@ -868,12 +886,14 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 # the closing frame OpenAI streaming clients expect: an
                 # empty delta carrying finish_reason, then [DONE]. The
-                # stream surface carries text (not token counts), so the
-                # reason is the generic "stop".
+                # reason comes from the generation loop itself (length =
+                # budget truncation, stop = EOS), matching what the same
+                # request reports non-streamed.
+                reason = (finish or {}).get("reason", "stop")
                 final_choice = (
-                    {"index": 0, "delta": {}, "finish_reason": "stop"}
+                    {"index": 0, "delta": {}, "finish_reason": reason}
                     if chat else
-                    {"index": 0, "text": "", "finish_reason": "stop"}
+                    {"index": 0, "text": "", "finish_reason": reason}
                 )
                 self._write_raw(("data: " + json.dumps({
                     "id": sid,
