@@ -1,0 +1,44 @@
+#!/bin/bash
+# The round-5 measurement program (VERDICT r04 Next #1): run the moment
+# the chip is back. Produces /tmp/bench_r05_sweep/*.json, one variant per
+# file — the evidence for flipping dispatch/optimizer/capacity defaults.
+#
+# Knob reference: bench.py module docstring (BENCH_MOE_DISPATCH,
+# BENCH_OPT, BENCH_REMAT, BENCH_MOE_BATCH, BENCH_DECODE_KV,
+# BENCH_ISOLATION, BENCH_DEADLINE_S; `--section X` runs one section).
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-/tmp/bench_r05_sweep}
+mkdir -p "$OUT"
+
+run() {   # run NAME [--section X] [ENV...]
+  local name="$1"; shift
+  local args=()
+  if [ "${1:-}" = "--section" ]; then args=(--section "$2"); shift 2; fi
+  echo "=== $name ${args[*]:-} ($*)" >&2
+  env "$@" timeout 2400 python bench.py "${args[@]}" \
+    > "$OUT/$name.json" 2> "$OUT/$name.log"
+  echo "rc=$? -> $OUT/$name.json" >&2
+}
+
+# 1) defaults — the driver's exact view (dense + moe + decode, isolated)
+run defaults BENCH_DEADLINE_S=2100
+# 2) dense regression attribution: co-resident (isolation off) vs default
+run dense_coresident BENCH_ISOLATION=0 BENCH_DECODE_NEW= BENCH_MOE_MODEL= BENCH_DEADLINE_S=1200
+run dense_noremat --section dense BENCH_REMAT=0 BENCH_DEADLINE_S=1200
+# 3) MoE dispatch sweep
+run moe_grouped  --section moe BENCH_MOE_DISPATCH=grouped BENCH_DEADLINE_S=1200
+run moe_gather   --section moe BENCH_MOE_DISPATCH=gather  BENCH_DEADLINE_S=1200
+run moe_einsum   --section moe BENCH_MOE_DISPATCH=einsum  BENCH_DEADLINE_S=1200
+# 4) optimizer
+run moe_adafactor --section moe BENCH_OPT=adafactor BENCH_DEADLINE_S=1200
+run moe_grouped_adafactor --section moe BENCH_MOE_DISPATCH=grouped BENCH_OPT=adafactor BENCH_DEADLINE_S=1200
+# 5) batch
+run moe_batch8 --section moe BENCH_MOE_BATCH=8 BENCH_DEADLINE_S=1200
+run moe_grouped_batch8 --section moe BENCH_MOE_DISPATCH=grouped BENCH_MOE_BATCH=8 BENCH_DEADLINE_S=1200
+# 6) decode: bf16 + int8 weights (default on) + int8 KV
+run decode_default --section decode BENCH_DEADLINE_S=900
+run decode_kv8     --section decode BENCH_DECODE_KV=1 BENCH_DEADLINE_S=900
+run decode_batch16 --section decode BENCH_DECODE_BATCH=16 BENCH_DEADLINE_S=900
+
+echo "sweep done: $(ls "$OUT" | wc -l) artifacts in $OUT" >&2

@@ -28,4 +28,4 @@ Layer map (mirrors reference SURVEY.md §1):
   models/, ops/, parallel/, train/ — the TPU compute stack (new; north star)
 """
 
-__version__ = "0.7.0"
+__version__ = "0.8.0"
