@@ -416,3 +416,22 @@ def test_grouped_ep_train_step_and_remat():
     assert wg.addressable_shards[0].data.size == wg.size // 4, (
         "expert weights are not sharded 4-way"
     )
+
+
+def test_grouped_ep_eval_step():
+    """make_eval_step activates the EP context too — evaluation over an
+    expert-parallel mesh matches the single-device loss."""
+    from tpu_kubernetes.train import TrainConfig, init_state, make_eval_step
+
+    cfg = replace(CFG, dispatch_mode="grouped", dtype=jnp.float32)
+    mesh, p_sh, _ = _ep_setup(cfg)
+    state = init_state(jax.random.PRNGKey(0), cfg, TrainConfig())
+    eval_step, b_sh = make_eval_step(cfg, mesh, state)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(11), (8, 65), 0, cfg.vocab_size, jnp.int32
+    )
+    ref = float(loss_fn(state["params"], tokens, cfg))
+    got = float(eval_step(
+        jax.device_put(state["params"], p_sh), jax.device_put(tokens, b_sh)
+    ))
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)

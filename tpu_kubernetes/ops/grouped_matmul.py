@@ -42,6 +42,7 @@ training stack's MoE family (SURVEY.md §2.7).
 from __future__ import annotations
 
 import functools
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -60,6 +61,8 @@ except Exception:  # pragma: no cover
 DEFAULT_BLOCK_M = 128
 DEFAULT_BLOCK_N = 512
 DEFAULT_BLOCK_K = 512
+
+_warned_tpu_fallback = False
 
 
 def _fit_block_div(block: int, dim: int) -> int:
@@ -382,6 +385,26 @@ def grouped_matmul(
     if use_pallas is None:
         use_pallas = _on_tpu()
     if pltpu is None or not (use_pallas or interpret):
+        if _on_tpu():
+            # the reference is O(E·M·K·N) — fine for tests, a silent
+            # E× throughput tax if it engages on real hardware. Warn
+            # once, loudly, naming the actual cause.
+            global _warned_tpu_fallback
+            if not _warned_tpu_fallback:
+                _warned_tpu_fallback = True
+                cause = (
+                    "jax.experimental.pallas.tpu failed to import — "
+                    "the jax install cannot run the kernel"
+                    if pltpu is None else
+                    "use_pallas=False was passed — leave it unset (or "
+                    "True) for the kernel path"
+                )
+                print(
+                    "[grouped_matmul] WARNING: XLA reference fallback on "
+                    f"a TPU backend (O(E*M*K*N) flops — every expert "
+                    f"multiplies every row): {cause}.",
+                    file=sys.stderr, flush=True,
+                )
         return grouped_matmul_reference(lhs, rhs, group_sizes)
 
     block_m = _fit_block(block_m, m)
