@@ -32,6 +32,7 @@ BENCH_MOE_BATCH (default BENCH_BATCH),
 BENCH_DECODE_BATCH/PROMPT/NEW (empty BENCH_DECODE_NEW skips decode),
 BENCH_DECODE_INT8 (default on; empty skips the int8-export timing),
 BENCH_DECODE_KV (=1 adds the int8-KV-cache timing; off by default),
+BENCH_DECODE_PROFILE (=1 adds the per-token step decomposition),
 BENCH_PROBE_TRIES (default 4 — each try is a ≤150 s subprocess probe).
 """
 
@@ -519,6 +520,24 @@ def measure_decode(model_name: str, batch: int, prompt_len: int,
                 log(f"decode-kvint8 failed: {e}")
                 kv_result = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+        profile = None
+        if os.environ.get("BENCH_DECODE_PROFILE", "").strip().lower() not in (
+            "", "0", "false", "no", "off",
+        ):
+            # attribute the roofline gap (r03: 3.24 ms measured vs ~2.2 ms
+            # floor): time the pieces of one decode step as separate
+            # programs — full step (hidden + lm_head), headless hidden
+            # step, the lm_head matmul alone, and the bare dispatch floor
+            # — so the overhead names itself instead of being guessed at
+            try:
+                profile = _decode_profile(
+                    cfg, params, prompt, prompt_len, max_new, batch
+                )
+                log(f"decode-profile: {profile}")
+            except Exception as e:  # noqa: BLE001 — extra stays in-band
+                log(f"decode-profile failed: {e}")
+                profile = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     out = {
         "model": model_name,
         **bf16_result,
@@ -531,7 +550,80 @@ def measure_decode(model_name: str, batch: int, prompt_len: int,
         out["int8"] = int8_result
     if kv_result is not None:
         out["kv_int8"] = kv_result
+    if profile is not None:
+        out["profile"] = profile
     return out
+
+
+def _decode_profile(cfg, params, prompt, prompt_len: int, max_new: int,
+                    batch: int) -> dict:
+    """Per-token step decomposition, each piece its own jitted program
+    timed at a representative cache fill (prompt + max_new/2):
+
+      step_ms        — decode_step (hidden layers + final norm + lm_head)
+      hidden_ms      — the same step WITHOUT the lm_head tail
+      lm_head_ms     — the (batch, d) @ (d, vocab) logits matmul alone
+      dispatch_ms    — a trivial jitted add (per-call runtime floor)
+
+    step−hidden ≈ the logits tail; hidden−(weights-stream floor) ≈
+    attention/cache+overhead; dispatch bounds the Python/runtime cost the
+    fused generate scan does NOT pay (its steps run inside one program) —
+    if step_ms ≫ hidden_ms + lm_head_ms the gap is program overhead, not
+    memory traffic."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_kubernetes.models.decode import (
+        _decode_chunk_hidden,
+        decode_step,
+        prefill,
+    )
+
+    reps = 20
+    span = prompt_len + max_new
+
+    def timed(fn, *args) -> float:
+        out = fn(*args)               # compile
+        _sync(out)
+        rtt = measure_rtt()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        _sync(out)
+        return max(1e-9, time.perf_counter() - t0 - rtt) / reps
+
+    _, cache = jax.jit(
+        lambda p, t: prefill(p, t, cfg, max_seq=span)
+    )(params, prompt)
+    # advance to the representative fill the roofline uses
+    cache = cache._replace(
+        length=jnp.asarray(prompt_len + max_new // 2, jnp.int32)
+    )
+    tok = jnp.zeros((batch,), jnp.int32)
+
+    step = jax.jit(lambda p, c, t: decode_step(p, c, t, cfg)[0])
+    step_ms = timed(step, params, cache, tok) * 1e3
+
+    hidden = jax.jit(
+        lambda p, c, t: _decode_chunk_hidden(p, c, t[:, None], cfg)[0]
+    )
+    hidden_ms = timed(hidden, params, cache, tok) * 1e3
+
+    x = jnp.zeros((batch, cfg.d_model), cfg.dtype)
+    lm = jax.jit(lambda p, a: (a @ p).astype(jnp.float32))
+    lm_head_ms = timed(lm, params["lm_head"], x) * 1e3
+
+    tiny = jnp.zeros((8,), jnp.float32)
+    noop = jax.jit(lambda a: a + 1.0)
+    dispatch_ms = timed(noop, tiny) * 1e3
+
+    return {
+        "step_ms": round(step_ms, 3),
+        "hidden_ms": round(hidden_ms, 3),
+        "lm_head_ms": round(lm_head_ms, 3),
+        "dispatch_ms": round(dispatch_ms, 3),
+        "cache_fill": prompt_len + max_new // 2,
+    }
 
 
 def _init_backend():

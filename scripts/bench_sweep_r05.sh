@@ -40,5 +40,6 @@ run moe_grouped_batch8 --section moe BENCH_MOE_DISPATCH=grouped BENCH_MOE_BATCH=
 run decode_default --section decode BENCH_DEADLINE_S=900
 run decode_kv8     --section decode BENCH_DECODE_KV=1 BENCH_DEADLINE_S=900
 run decode_batch16 --section decode BENCH_DECODE_BATCH=16 BENCH_DEADLINE_S=900
+run decode_profile  --section decode BENCH_DECODE_PROFILE=1 BENCH_DECODE_INT8= BENCH_DEADLINE_S=1200
 
 echo "sweep done: $(ls "$OUT" | wc -l) artifacts in $OUT" >&2
