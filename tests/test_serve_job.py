@@ -225,3 +225,19 @@ def test_multihost_serving_token_parity(tmp_path, prompts_file):
     assert (tmp_path / "mh0.txt").read_text() == ref_out.read_text()
     # only process 0 writes the output file
     assert not (tmp_path / "mh1.txt").exists()
+
+
+def test_draft_kv_quant_serving_runs_and_rejections(tmp_path, prompts_file):
+    """SERVE_DRAFT_KV_QUANT quantizes only the draft cache; forbidden
+    without a draft model (prompt-lookup has no draft cache)."""
+    completions = run_serving(_env(
+        prompts_file, tmp_path / "o.txt",
+        SERVE_DRAFT_MODEL="llama-test", SERVE_DRAFT_KV_QUANT="1",
+        SERVE_MAX_NEW="4",
+    ))
+    assert len(completions) == 3
+    with pytest.raises(SystemExit, match="needs a draft model"):
+        run_serving(_env(
+            prompts_file, tmp_path / "o2.txt",
+            SERVE_PROMPT_LOOKUP="1", SERVE_DRAFT_KV_QUANT="1",
+        ))

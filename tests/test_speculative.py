@@ -251,3 +251,16 @@ def test_batch_gt1_rejected(target_params):
         speculative_generate(
             target_params, target_params, two, CFG, CFG, 4
         )
+
+
+def test_draft_kv_quant_still_exact(target_params, prompt, oracle_at):
+    """An int8 KV cache on the DRAFT changes its proposals (quantization
+    noise) but can never change the output — verification keeps exactly
+    the target's greedy choices."""
+    draft_params = init_params(jax.random.PRNGKey(123), CFG)
+    out, stats = speculative_generate(
+        target_params, draft_params, prompt, CFG, CFG, MAX_NEW,
+        draft_k=4, draft_kv_quant=True,
+    )
+    np.testing.assert_array_equal(np.asarray(out), oracle_at(4))
+    assert int(stats.rounds) <= MAX_NEW

@@ -162,12 +162,20 @@ def speculative_generate(
     max_new_tokens: int,
     *,
     draft_k: int = 4,
+    draft_kv_quant: bool = False,
 ) -> tuple[jax.Array, SpecStats]:
     """prompt (1, prompt_len) int32 → ((1, max_new_tokens) int32, stats).
 
     Draft-model speculative decoding; the emitted tokens are exactly
     ``generate(params, prompt, cfg, max_new_tokens)`` (temperature 0).
     Jittable end to end with static cfg/max_new_tokens/draft_k.
+
+    ``draft_kv_quant`` runs the DRAFT model on an int8 KV cache
+    (models/decode.KVCache): drafts only propose, never verify, so
+    quantization noise can change the acceptance rate but NEVER the
+    output — the exactness-first composition rule that forbids int8 on
+    the verification cache does not apply to the draft's. Halves the
+    draft's per-step cache traffic; the win grows with context length.
     """
     _check_target(cfg, prompt)
     if draft_k < 1:
@@ -182,7 +190,10 @@ def speculative_generate(
                 f"exceeds {name} max_seq {c.max_seq}"
             )
 
-    _, cache_d0 = prefill(draft_params, prompt, draft_cfg, max_seq=span)
+    _, cache_d0 = prefill(
+        draft_params, prompt, draft_cfg, max_seq=span,
+        kv_quant=draft_kv_quant,
+    )
     k = draft_k
 
     def propose(last, out, cursor, cache_d):

@@ -53,6 +53,11 @@ whole pipeline is env-driven like the trainer:
                        seen context propose continuations
                        (SERVE_DRAFT_K defaults to 8 here). Exclusive
                        with SERVE_DRAFT_*; same greedy/batch-1 rules.
+  SERVE_DRAFT_KV_QUANT =1: int8 KV cache for the DRAFT model only —
+                       drafts propose, never verify, so this can change
+                       the acceptance rate but never the tokens (the
+                       target's verification cache stays full
+                       precision). Requires SERVE_DRAFT_*.
 
 The reference provisioner has no inference plane (SURVEY §0); this
 completes the in-tree stack's serving story end to end (provision →
@@ -243,6 +248,13 @@ def run_serving(env: dict | None = None) -> list[str]:
     draft_name = env.get("SERVE_DRAFT_MODEL", "")
     lookup = truthy_env(env, "SERVE_PROMPT_LOOKUP")
     kv_quant = truthy_env(env, "SERVE_KV_QUANT")
+    if truthy_env(env, "SERVE_DRAFT_KV_QUANT") and not (draft_hf or draft_name):
+        # refuse rather than silently drop the knob (file policy): with
+        # no draft model there is no draft cache to quantize
+        raise SystemExit(
+            "SERVE_DRAFT_KV_QUANT needs a draft model "
+            "(SERVE_DRAFT_MODEL / SERVE_DRAFT_HF_CHECKPOINT)"
+        )
     if draft_hf or draft_name or lookup:
         if kv_quant:
             # refuse rather than silently drop the knob: the speculative
@@ -287,6 +299,10 @@ def run_serving(env: dict | None = None) -> list[str]:
                 "SERVE_PROMPT_LOOKUP and SERVE_DRAFT_* are exclusive — "
                 "pick one drafting strategy"
             )
+        # lookup + SERVE_DRAFT_KV_QUANT already failed the top-level
+        # needs-a-draft-model check (lookup has no draft model by the
+        # exclusivity rule above)
+        draft_kv = truthy_env(env, "SERVE_DRAFT_KV_QUANT")
         if lookup:
             from tpu_kubernetes.models import prompt_lookup_generate
 
@@ -321,9 +337,13 @@ def run_serving(env: dict | None = None) -> list[str]:
                     f"+ SERVE_DRAFT_K ({draft_k}) exceeds the draft "
                     f"model's max_seq {draft_cfg.max_seq}"
                 )
+            if draft_kv:
+                log("draft: int8 KV cache (proposals only — exactness "
+                    "unaffected)")
             spec = jax.jit(functools.partial(
                 speculative_generate, cfg=cfg, draft_cfg=draft_cfg,
                 max_new_tokens=max_new, draft_k=draft_k,
+                draft_kv_quant=draft_kv,
             ))
 
             def run_one(row):
