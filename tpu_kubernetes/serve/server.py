@@ -99,6 +99,15 @@ def _bucket(n: int, lo: int = 16) -> int:
     return b
 
 
+def _is_greedy(temperature, top_k, top_p) -> bool:
+    """Default-sampling test shared by complete()/stream(): only these
+    requests may batch or speculate (both rely on greedy determinism)."""
+    return (
+        float(temperature) == 0.0 and int(top_k) == 0
+        and float(top_p) == 0.0
+    )
+
+
 def _bucket_max_new(n: int, cap: int) -> int:
     """Round a requested max_new up to a power-of-two bucket (≤ cap):
     compiled programs are keyed on max_new, so raw client values would
@@ -218,7 +227,6 @@ class ServingState:
         self.draft_k = int(env.get("SERVE_DRAFT_K", "8"))
         self.ngram = int(env.get("SERVE_NGRAM", "2"))
         self.spec_totals = {"rounds": 0, "drafted": 0, "accepted": 0}
-        self._last_spec: dict | None = None
         eos_env = env.get("SERVE_EOS_ID", "")
         self.eos_id = int(eos_env) if eos_env else None
         self.model_name = env.get("SERVE_HF_CHECKPOINT", "") or env.get(
@@ -418,7 +426,9 @@ class ServingState:
         proposals. Yields each round's newly accepted tokens; stops at
         ``max_new`` or EOS. Cache rollback is O(1) — rewind ``length``,
         stale slots are masked (models/speculative.py's invariant).
-        Caller holds the generation lock."""
+        Caller holds the generation lock. ``finish`` (when given)
+        receives "reason" on natural completion and "spec" (the
+        per-request telemetry) even on early close."""
         jax = self._jax
         import functools
 
@@ -495,10 +505,11 @@ class ServingState:
             self.spec_totals["rounds"] += rounds + 1   # +1: the prefill
             self.spec_totals["drafted"] += drafted
             self.spec_totals["accepted"] += accepted
-            self._last_spec = {
-                "rounds": rounds + 1, "drafted": drafted,
-                "accepted": accepted,
-            }
+            if finish is not None:
+                finish["spec"] = {
+                    "rounds": rounds + 1, "drafted": drafted,
+                    "accepted": accepted,
+                }
 
     def _safe_deltas(self, token_batches):
         """Token batches → UTF-8-safe text deltas (ONE implementation
@@ -543,21 +554,19 @@ class ServingState:
             prompt, max_new_tokens
         )
 
-        greedy_default = (
-            float(temperature) == 0.0 and int(top_k) == 0
-            and float(top_p) == 0.0
-        )
+        greedy_default = _is_greedy(temperature, top_k, top_p)
         spec = None
         if self.prompt_lookup and greedy_default:
             # draft-free speculation: tokens are exactly the greedy
             # decode at this cache span, EOS-trimmed by the loop
+            finish: dict = {}
             with self._lock:
                 tokens = [
                     t for new in self._lookup_rounds(
-                        ids, width, run_max_new, max_new
+                        ids, width, run_max_new, max_new, finish
                     ) for t in new
                 ]
-                spec = self._last_spec
+            spec = finish.get("spec")
         elif self._batcher is not None and greedy_default:
             # greedy rows coalesce without changing output, by the
             # ragged-row identity (up to the documented cache-span
@@ -611,11 +620,7 @@ class ServingState:
         ids, max_new, run_max_new, width = self._validate(
             prompt, max_new_tokens
         )
-        greedy_default = (
-            float(temperature) == 0.0 and int(top_k) == 0
-            and float(top_p) == 0.0
-        )
-        if self.prompt_lookup and greedy_default:
+        if self.prompt_lookup and _is_greedy(temperature, top_k, top_p):
             # speculation composes with streaming because the loop is
             # host-driven: whole ROUNDS of tokens surface at once (better
             # than per-token pacing when proposals are accepted)
@@ -895,16 +900,8 @@ class _Handler(BaseHTTPRequestHandler):
                     if chat else
                     {"index": 0, "text": "", "finish_reason": reason}
                 )
-                self._write_raw(("data: " + json.dumps({
-                    "id": sid,
-                    "object": (
-                        "chat.completion.chunk" if chat
-                        else "text_completion"
-                    ),
-                    "created": created,
-                    "model": self.state.model_name,
-                    "choices": [final_choice],
-                }) + "\n\n").encode("utf-8"))
+                self._write_raw(self._sse_frame(chat, sid, created,
+                                                final_choice))
                 self._write_raw(b"data: [DONE]\n\n")
                 self.wfile.write(b"0\r\n\r\n")
         except (BrokenPipeError, ConnectionResetError):
@@ -921,11 +918,22 @@ class _Handler(BaseHTTPRequestHandler):
                 # at GC time
                 pieces.close()
 
+    def _sse_frame(self, chat: bool, sid: str, created: int,
+                   choice: dict) -> bytes:
+        """One SSE data: frame in the OpenAI chunk envelope."""
+        obj = {
+            "id": sid,
+            "object": "chat.completion.chunk" if chat else "text_completion",
+            "created": created,
+            "model": self.state.model_name,
+            "choices": [choice],
+        }
+        return f"data: {json.dumps(obj)}\n\n".encode("utf-8")
+
     def _write_sse(self, piece: str, chat: bool, sid: str,
                    created: int) -> None:
         if not piece:
             return
-        st = self.state
         if chat:
             choice = {
                 "index": 0, "delta": {"content": piece},
@@ -933,14 +941,7 @@ class _Handler(BaseHTTPRequestHandler):
             }
         else:
             choice = {"index": 0, "text": piece, "finish_reason": None}
-        obj = {
-            "id": sid,
-            "object": "chat.completion.chunk" if chat else "text_completion",
-            "created": created,
-            "model": st.model_name,
-            "choices": [choice],
-        }
-        self._write_raw(f"data: {json.dumps(obj)}\n\n".encode("utf-8"))
+        self._write_raw(self._sse_frame(chat, sid, created, choice))
 
     def _write_raw(self, data: bytes) -> None:
         """One HTTP/1.1 chunk carrying one SSE frame."""
