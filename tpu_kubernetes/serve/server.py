@@ -35,7 +35,10 @@ Model bring-up reuses the batch job's env contract exactly
 SERVE_TOKENIZER / SERVE_QUANT), plus SERVE_KV_QUANT for the int8 KV
 cache, SERVE_EOS_ID (tokens after it are truncated from responses),
 SERVER_HOST/SERVER_PORT, SERVER_BATCH/SERVER_BATCH_WINDOW_MS (dynamic
-batching), SERVE_MAX_NEW as the per-request ``max_new_tokens`` cap, and
+batching), SERVE_MAX_NEW as the per-request ``max_new_tokens`` cap,
+SERVE_MESH (e.g. ``tensor=4``) — tensor-sharded fused generation over
+this host's chips, so models bigger than one chip's HBM serve live
+(streaming and prompt-lookup stay single-device and say so) — and
 SERVE_PROMPT_LOOKUP (+SERVE_DRAFT_K/SERVE_NGRAM) — draft-model-free
 speculative decoding for greedy requests, streaming included: host-side
 n-gram proposals verified by one jitted (k+1)-token chunk per round, so
@@ -234,6 +237,55 @@ class ServingState:
         )
         self._lock = threading.Lock()
         self._jax = jax
+
+        # SERVE_MESH (e.g. "tensor=4"): serve the fused path TENSOR-
+        # SHARDED over this host's chips (parallel/serving.py) — models
+        # bigger than one chip's HBM serve live. Batch-carrying axes are
+        # rejected (requests are batch-1 rows; sharding the batch dim
+        # would make single requests unshardable), and the streaming /
+        # prompt-lookup paths stay single-device by design.
+        self.mesh = None
+        mesh_spec = env.get("SERVE_MESH", "")
+        if mesh_spec:
+            import math
+
+            from tpu_kubernetes.parallel import create_mesh
+            from tpu_kubernetes.parallel.mesh import DATA_AXES
+            from tpu_kubernetes.parallel.serving import (
+                serving_param_shardings,
+            )
+            from tpu_kubernetes.topology import TopologyError, parse_mesh_shape
+
+            if truthy_env(env, "SERVE_PROMPT_LOOKUP"):
+                # rejected BEFORE the mesh build + cross-chip device_put
+                # below — an always-doomed config must fail cheaply
+                raise ValueError(
+                    "SERVE_PROMPT_LOOKUP and SERVE_MESH are exclusive "
+                    "(the speculation loop is single-device)"
+                )
+            try:
+                shape = parse_mesh_shape(mesh_spec)
+            except TopologyError as e:
+                # main() maps ValueError to a one-line config diagnostic
+                raise ValueError(f"SERVE_MESH: {e}") from e
+            bad = [a for a in shape if a in DATA_AXES and shape[a] > 1]
+            if bad:
+                raise ValueError(
+                    f"SERVE_MESH axes {bad} shard the batch — live "
+                    "requests are batch-1; use tensor (or sequence) axes"
+                )
+            total = math.prod(shape.values())
+            devs = jax.devices()
+            if total > len(devs):
+                raise ValueError(
+                    f"SERVE_MESH {mesh_spec!r} wants {total} devices, "
+                    f"host has {len(devs)}"
+                )
+            self.mesh = create_mesh(shape, devices=devs[:total])
+            self.params = jax.device_put(
+                params, serving_param_shardings(params, cfg, self.mesh)
+            )
+            log(f"sharded serving: mesh={dict(self.mesh.shape)}")
         # jitted programs keyed by their STATIC arguments — jax.jit's own
         # cache keys on callable identity, so a fresh partial per request
         # would re-trace+compile every time. Handler threads race on
@@ -248,6 +300,7 @@ class ServingState:
 
         if self.prompt_lookup:
             # mirror the batch job's loud config rejections (serve/job.py)
+            # (lookup × SERVE_MESH already rejected above, pre-mesh-build)
             if isinstance(cfg, MoEConfig):
                 raise ValueError(
                     "SERVE_PROMPT_LOOKUP needs a dense model (MoE chunk "
@@ -300,12 +353,14 @@ class ServingState:
         generate at the full max_new_tokens cap AND the streaming pair
         (prefill + decode step), greedy, smallest bucket — before going
         ready, so the readiness flip means real traffic (either mode)
-        runs at full speed."""
+        runs at full speed. Sharded serving warms only the fused path
+        (streaming is rejected there)."""
         self.complete("")
-        for _ in self.stream(""):
-            pass
+        if self.mesh is None:
+            for _ in self.stream(""):
+                pass
         self.ready = True
-        log("warm: default programs (fused + streaming) compiled, serving")
+        log("warm: default programs compiled, serving")
 
     def _cached_program(self, key, build):
         """Get-or-create a jitted program under the cache mutex. The
@@ -322,6 +377,26 @@ class ServingState:
         import functools
 
         from tpu_kubernetes.models import generate
+
+        if self.mesh is not None:
+            def build_sharded():
+                from tpu_kubernetes.parallel import make_sharded_generate
+
+                fn, _, _ = make_sharded_generate(
+                    self.cfg, self.mesh, self.params,
+                    max_new_tokens=max_new, temperature=temperature,
+                    top_k=top_k, top_p=top_p, eos_id=self.eos_id,
+                    kv_quant=self.kv_quant,
+                )
+                return fn
+
+            # same call convention as the jitted generate below —
+            # (params, prompt, rng=, prompt_lengths=) — so every fused
+            # call site (solo AND the batcher) shards transparently
+            return self._cached_program(
+                ("sharded", max_new, temperature, top_k, top_p),
+                build_sharded,
+            )
 
         return self._cached_program(
             (max_new, temperature, top_k, top_p),
@@ -617,6 +692,13 @@ class ServingState:
 
         from tpu_kubernetes.models.decode import _sample, decode_step, prefill
 
+        if self.mesh is not None:
+            # the per-token streaming loop is single-device; the fused
+            # sharded path is where a multi-chip model can answer
+            raise ValueError(
+                "streaming is not available under SERVE_MESH (sharded "
+                "serving uses the fused program) — drop \"stream\""
+            )
         ids, max_new, run_max_new, width = self._validate(
             prompt, max_new_tokens
         )
