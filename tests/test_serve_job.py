@@ -241,3 +241,12 @@ def test_draft_kv_quant_serving_runs_and_rejections(tmp_path, prompts_file):
             prompts_file, tmp_path / "o2.txt",
             SERVE_PROMPT_LOOKUP="1", SERVE_DRAFT_KV_QUANT="1",
         ))
+
+
+def test_partial_host_mesh(tmp_path, prompts_file):
+    """SERVE_MESH smaller than the host (tensor=4 on the 8-device test
+    mesh) serves on a device prefix instead of erroring."""
+    completions = run_serving(_env(
+        prompts_file, tmp_path / "o.txt", SERVE_MESH="tensor=4",
+    ))
+    assert len(completions) == 3

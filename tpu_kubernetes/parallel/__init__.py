@@ -15,6 +15,7 @@ from tpu_kubernetes.parallel.mesh import (  # noqa: F401
     create_hybrid_mesh,
     create_mesh,
     data_axes_in,
+    device_prefix_for,
     logical_to_spec,
     mesh_shape_for_devices,
     param_shardings,

@@ -59,6 +59,31 @@ def data_axes_in(mesh: Mesh) -> tuple[str, ...]:
     )
 
 
+def device_prefix_for(
+    shape: Mapping[str, int],
+    devices: Sequence[jax.Device],
+    allow_partial: bool = True,
+    label: str = "mesh",
+) -> list:
+    """Devices a ``shape``-sized mesh should use: a PREFIX of ``devices``
+    when the mesh is smaller than the host and partial use is allowed
+    (e.g. tensor=4 serving on a v5e-8), everything otherwise. Zero-size
+    axes are ignored (matching :func:`create_mesh`); asking for more
+    devices than exist is a clear, label-attributed error. One
+    implementation for every serving entry point, so the policy cannot
+    drift."""
+    sizes = [v for v in shape.values() if v != 0]
+    total = int(np.prod(sizes)) if sizes else 1
+    if total > len(devices):
+        raise ValueError(
+            f"{label} {dict(shape)} wants {total} devices, "
+            f"have {len(devices)}"
+        )
+    if allow_partial and total < len(devices):
+        return list(devices[:total])
+    return list(devices)
+
+
 def create_mesh(
     shape: Mapping[str, int], devices: Sequence[jax.Device] | None = None
 ) -> Mesh:

@@ -214,7 +214,23 @@ def run_serving(env: dict | None = None) -> list[str]:
         shape = parse_mesh_shape(mesh_spec)
     else:
         shape = {"tensor": len(jax.devices())}
-    mesh = create_mesh(shape)
+    from tpu_kubernetes.parallel import device_prefix_for
+
+    # a mesh smaller than the host is a valid ask (e.g. tensor=4 on a
+    # v5e-8) — take a device prefix, like the HTTP server. Multi-host
+    # keeps the strict all-devices contract: slicing global devices
+    # could leave a process with nothing addressable.
+    try:
+        devices = device_prefix_for(
+            shape, jax.devices(), allow_partial=not denv.multi_host,
+            label="SERVE_MESH",
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from e
+    if len(devices) < len(jax.devices()):
+        log(f"partial-host mesh: {len(devices)} of "
+            f"{len(jax.devices())} devices")
+    mesh = create_mesh(shape, devices=devices)
     log(f"mesh={dict(mesh.shape)}")
 
     max_new = int(env.get("SERVE_MAX_NEW", "64"))

@@ -247,9 +247,10 @@ class ServingState:
         self.mesh = None
         mesh_spec = env.get("SERVE_MESH", "")
         if mesh_spec:
-            import math
-
-            from tpu_kubernetes.parallel import create_mesh
+            from tpu_kubernetes.parallel import (
+                create_mesh,
+                device_prefix_for,
+            )
             from tpu_kubernetes.parallel.mesh import DATA_AXES
             from tpu_kubernetes.parallel.serving import (
                 serving_param_shardings,
@@ -274,14 +275,10 @@ class ServingState:
                     f"SERVE_MESH axes {bad} shard the batch — live "
                     "requests are batch-1; use tensor (or sequence) axes"
                 )
-            total = math.prod(shape.values())
-            devs = jax.devices()
-            if total > len(devs):
-                raise ValueError(
-                    f"SERVE_MESH {mesh_spec!r} wants {total} devices, "
-                    f"host has {len(devs)}"
-                )
-            self.mesh = create_mesh(shape, devices=devs[:total])
+            devs = device_prefix_for(
+                shape, jax.devices(), label="SERVE_MESH"
+            )
+            self.mesh = create_mesh(shape, devices=devs)
             self.params = jax.device_put(
                 params, serving_param_shardings(params, cfg, self.mesh)
             )
