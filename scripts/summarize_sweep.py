@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Summarize a bench_sweep_r05 output directory into the decision table
+the MoE design note pre-registered (docs/design/moe-performance.md,
+"Round 5" section): one row per variant, plus the rule-by-rule verdicts.
+
+Usage: python scripts/summarize_sweep.py [/tmp/bench_r05_sweep]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+
+def load(d: Path) -> dict[str, dict]:
+    out = {}
+    for p in sorted(d.glob("*.json")):
+        try:
+            text = p.read_text().strip()
+            out[p.stem] = json.loads(text.splitlines()[-1]) if text else {}
+        except Exception as e:  # noqa: BLE001 — a broken artifact is a row
+            out[p.stem] = {"error": f"unreadable: {e}"}
+    return out
+
+
+def pick(obj: dict, *keys, default=None):
+    for k in keys:
+        if isinstance(obj, dict) and k in obj:
+            obj = obj[k]
+        else:
+            return default
+    return obj
+
+
+def main() -> int:
+    d = Path(sys.argv[1] if len(sys.argv) > 1 else "/tmp/bench_r05_sweep")
+    runs = load(d)
+    if not runs:
+        print(f"no artifacts in {d}")
+        return 1
+
+    print(f"{'variant':28} {'mfu':>7} {'step_ms':>8} {'tok/s':>8}  note")
+    for name, r in runs.items():
+        err = r.get("error", "")
+        mfu = r.get("mfu", r.get("value"))
+        step = r.get("step_time_ms", r.get("per_token_ms"))
+        tps = r.get("tokens_per_sec_per_chip", r.get("tokens_per_sec"))
+        print(f"{name:28} {mfu if mfu is not None else '':>7} "
+              f"{step if step is not None else '':>8} "
+              f"{tps if tps is not None else '':>8}  {err[:60]}")
+
+    def mfu_of(name):
+        r = runs.get(name, {})
+        return r.get("mfu", r.get("value"))
+
+    print("\n-- pre-registered decision rules --")
+    g, ga = mfu_of("moe_grouped"), mfu_of("moe_gather")
+    if g and ga:
+        rel = (g - ga) / ga
+        print(f"grouped vs gather: {g:.4f} vs {ga:.4f} ({rel:+.1%}) -> "
+              + ("FLIP moe-1b dispatch_mode to 'grouped'" if rel >= 0.03
+                 else "keep 'gather', record grouped overhead"))
+    af, ad = mfu_of("moe_adafactor"), mfu_of("moe_gather")
+    if af and ad:
+        rel = (af - ad) / ad
+        print(f"adafactor vs adamw: {af:.4f} vs {ad:.4f} ({rel:+.1%}) -> "
+              + ("recommend Adafactor for MoE" if rel >= 0.03
+                 else "no recommendation change"))
+    b8, b4 = mfu_of("moe_batch8"), mfu_of("moe_gather")
+    if b8 and b4:
+        rel = (b8 - b4) / b4
+        print(f"batch8 vs batch4:  {b8:.4f} vs {b4:.4f} ({rel:+.1%}) -> "
+              + ("raise bench MoE batch" if rel >= 0.05 else "keep batch"))
+    iso = pick(runs.get("defaults", {}), "value")
+    co = pick(runs.get("dense_coresident", {}), "value")
+    if iso and co:
+        print(f"dense isolated vs co-resident: {iso:.4f} vs {co:.4f} -> "
+              + ("r03 regression attributed to co-residency"
+                 if co < iso else "co-residency NOT the cause — investigate"))
+    dec = runs.get("decode_default", {})
+    frac = dec.get("fraction_of_hbm_roofline")
+    if frac is not None:
+        prof = pick(runs.get("decode_profile", {}), "profile") or {}
+        print(f"decode fraction_of_hbm_roofline={frac}"
+              + (f"; profile: {prof}" if prof else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
