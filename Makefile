@@ -3,7 +3,7 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast bench dryrun native dist dist-offline clean
+.PHONY: test test-fast obs-check bench dryrun native dist dist-offline clean
 
 test:
 	python -m pytest tests/ -q
@@ -15,6 +15,14 @@ native:
 
 test-fast:
 	python -m pytest tests/ -q -m "not slow"
+
+# Fast observability smoke: registry/events/tracer units plus a live CPU
+# server boot that scrapes GET /metrics (docs/guide/observability.md).
+obs-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py \
+	  "tests/test_server.py::test_metrics_endpoint_prometheus_exposition" \
+	  "tests/test_server.py::test_healthz_reports_token_counters" \
+	  -q -m "not slow"
 
 bench:
 	python bench.py

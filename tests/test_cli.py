@@ -110,6 +110,36 @@ def test_silent_install_end_to_end(cli_home, tmp_path, capsys):
     assert state_file.exists()
 
 
+def test_get_runs_and_metrics(cli_home, capsys, tmp_path):
+    mgr = write_yaml(tmp_path, "mgr.yaml", MANAGER_YAML)
+    assert run(["--config", mgr, "--non-interactive", "create", "manager"]) == 0
+    capsys.readouterr()
+
+    # human rendering: newest-first summary plus the latest run's phases
+    assert run([
+        "--non-interactive", "--set", "backend_provider=local",
+        "get", "runs", "--manager", "dev",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "latest: create manager" in out
+    assert "apply manager" in out
+    assert "run_id=" in out
+
+    assert run([
+        "--non-interactive", "--set", "backend_provider=local",
+        "get", "runs", "--manager", "dev", "--json",
+    ]) == 0
+    reports = json.loads(capsys.readouterr().out)
+    assert reports[-1]["command"] == "create manager"
+    assert [p["phase"] for p in reports[-1]["phases"]]
+
+    # registry dump needs no backend (and no prompts)
+    assert run(["get", "metrics"]) == 0
+    text = capsys.readouterr().out
+    assert "# TYPE tpu_tf_command_seconds histogram" in text
+    assert "# TYPE tpu_tf_failures_total counter" in text
+
+
 def test_missing_required_key_exits_1(cli_home, capsys):
     assert run(["--non-interactive", "create", "manager"]) == 1
     assert "must be specified" in capsys.readouterr().err

@@ -1,0 +1,314 @@
+"""Observability subsystem tests: the metrics registry (labels, buckets,
+Prometheus exposition), the structured-event sink, the bounded tracer, and
+``get runs`` end-to-end against a local backend."""
+
+import io
+import json
+import threading
+
+import pytest
+
+from tpu_kubernetes.obs import events
+from tpu_kubernetes.obs.metrics import (
+    CONTENT_TYPE,
+    MetricError,
+    Registry,
+)
+from tpu_kubernetes.util.trace import Tracer
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_counter_inc_and_get_or_create():
+    reg = Registry()
+    c = reg.counter("requests_total", "requests")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    # get-or-create: same family object every time
+    assert reg.counter("requests_total", "requests") is c
+    with pytest.raises(MetricError):
+        c.inc(-1)
+
+
+def test_kind_mismatch_rejected():
+    reg = Registry()
+    reg.counter("x_total", "x")
+    with pytest.raises(MetricError):
+        reg.gauge("x_total", "x")
+    with pytest.raises(MetricError):
+        reg.counter("x_total", "x", labelnames=("a",))
+
+
+def test_gauge_set_inc_dec():
+    reg = Registry()
+    g = reg.gauge("temp", "t")
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g.value == 4
+
+
+def test_labels_positional_and_by_name():
+    reg = Registry()
+    c = reg.counter("ops_total", "ops", labelnames=("command", "status"))
+    c.labels("apply", "ok").inc()
+    c.labels(command="apply", status="ok").inc()
+    assert c.labels("apply", "ok").value == 2
+    with pytest.raises(MetricError):
+        c.labels("apply")  # wrong arity
+    with pytest.raises(MetricError):
+        c.labels(command="apply", nope="x")
+    with pytest.raises(MetricError):
+        c.inc()  # labeled family has no solo child
+
+
+def test_histogram_buckets_le_semantics():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", "l", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 1.0, 5.0, 100.0):
+        h.observe(v)
+    text = reg.render()
+    # cumulative ≤: boundary values land in their own bucket
+    assert 'lat_seconds_bucket{le="0.1"} 2' in text
+    assert 'lat_seconds_bucket{le="1"} 4' in text
+    assert 'lat_seconds_bucket{le="10"} 5' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 6' in text
+    assert "lat_seconds_count 6" in text
+    assert "lat_seconds_sum 106.65" in text
+
+
+def test_exposition_golden():
+    reg = Registry()
+    c = reg.counter("tf_runs_total", "terraform runs", labelnames=("command",))
+    c.labels("apply").inc(3)
+    g = reg.gauge("workers", "worker count")
+    g.set(2)
+    assert reg.render() == (
+        "# HELP tf_runs_total terraform runs\n"
+        "# TYPE tf_runs_total counter\n"
+        'tf_runs_total{command="apply"} 3\n'
+        "# HELP workers worker count\n"
+        "# TYPE workers gauge\n"
+        "workers 2\n"
+    )
+    assert CONTENT_TYPE.startswith("text/plain; version=0.0.4")
+
+
+def test_label_value_escaping():
+    reg = Registry()
+    c = reg.counter("weird_total", "w", labelnames=("path",))
+    c.labels('a"b\\c\nd').inc()
+    assert 'weird_total{path="a\\"b\\\\c\\nd"} 1' in reg.render()
+
+
+def test_snapshot_prefix_filter():
+    reg = Registry()
+    reg.counter("tpu_tf_failures_total", "f").inc()
+    reg.gauge("tpu_serve_workers", "w").set(1)
+    snap = reg.snapshot(prefix="tpu_tf_")
+    assert list(snap) == ["tpu_tf_failures_total"]
+    assert snap["tpu_tf_failures_total"]["samples"][0]["value"] == 1
+    h = reg.histogram("tpu_tf_command_seconds", "s", buckets=(1.0,))
+    h.observe(0.5)
+    sample = reg.snapshot()["tpu_tf_command_seconds"]["samples"][0]
+    assert sample["count"] == 1 and sample["sum"] == 0.5
+
+
+def test_registry_thread_safety():
+    reg = Registry()
+    c = reg.counter("n_total", "n", labelnames=("who",))
+
+    def work(who):
+        for _ in range(1000):
+            c.labels(who).inc()
+
+    threads = [threading.Thread(target=work, args=(str(i % 3),)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(c.labels(str(i)).value for i in range(3)) == 6000
+
+
+# -- structured events ------------------------------------------------------
+
+
+@pytest.fixture()
+def sink():
+    buf = io.StringIO()
+    events.configure(stream=buf)
+    yield buf
+    events.configure()  # remove
+
+
+def read_events(buf):
+    return [json.loads(line) for line in buf.getvalue().splitlines()]
+
+
+def test_events_disabled_without_sink():
+    events.configure()
+    events.emit("noop")  # must not raise, and write nowhere
+
+
+def test_run_and_span_correlation(sink):
+    with events.run_context() as rid:
+        with events.span("outer") as outer_id:
+            with events.span("inner"):
+                events.emit("progress", pct=50)
+    evs = read_events(sink)
+    assert [e["kind"] for e in evs] == [
+        "span_start", "span_start", "progress", "span_end", "span_end",
+    ]
+    assert all(e["run"] == rid for e in evs)
+    inner_start = evs[1]
+    assert inner_start["parent"] == outer_id
+    assert evs[2]["span"] == inner_start["span"]  # progress nested in inner
+    assert evs[3]["status"] == "ok" and evs[3]["seconds"] >= 0
+
+
+def test_span_error_status(sink):
+    with pytest.raises(RuntimeError):
+        with events.span("doomed"):
+            raise RuntimeError("boom")
+    end = read_events(sink)[-1]
+    assert end["kind"] == "span_end" and end["status"] == "error"
+
+
+def test_emit_never_raises():
+    class Exploding(io.StringIO):
+        def write(self, *_):
+            raise OSError("disk gone")
+
+    events.configure(stream=Exploding())
+    try:
+        events.emit("anything")  # swallowed
+    finally:
+        events.configure()
+
+
+# -- bounded tracer ---------------------------------------------------------
+
+
+def test_tracer_phase_records_and_reports():
+    tr = Tracer(stream=io.StringIO())
+    mark = tr.mark()
+    with tr.phase("render", manager="dev"):
+        pass
+    with tr.phase("apply"):
+        pass
+    report = tr.report(since=mark)
+    assert [p["phase"] for p in report] == ["render", "apply"]
+    assert report[0]["manager"] == "dev"
+    assert all(p["seconds"] >= 0 for p in report)
+
+
+def test_tracer_nesting_links_spans(tmp_path):
+    tr = Tracer(stream=io.StringIO())
+    with tr.phase("outer") as outer:
+        with tr.phase("inner") as inner:
+            pass
+    assert inner.parent_id == outer.span_id
+
+
+def test_tracer_ring_eviction_keeps_marks_valid():
+    tr = Tracer(stream=io.StringIO(), max_spans=4)
+    for _ in range(3):
+        with tr.phase("early"):
+            pass
+    mark = tr.mark()
+    for i in range(4):  # evicts all three "early" spans
+        with tr.phase(f"late{i}"):
+            pass
+    assert [p["phase"] for p in tr.report(since=mark)] == [
+        "late0", "late1", "late2", "late3",
+    ]
+    assert len(tr.spans) == 4
+
+
+def test_tracer_reset_since():
+    tr = Tracer(stream=io.StringIO())
+    with tr.phase("old"):
+        pass
+    mark = tr.mark()
+    with tr.phase("new"):
+        pass
+    tr.reset(since=mark)
+    assert [p["phase"] for p in tr.report()] == ["new"]
+    tr.reset()
+    assert tr.report() == []
+
+
+def test_tracer_thread_safety():
+    tr = Tracer(stream=io.StringIO(), max_spans=64)
+
+    def work():
+        for _ in range(50):
+            with tr.phase("p"):
+                pass
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert tr.mark() == 200
+    assert len(tr.spans) == 64
+
+
+# -- run reports + get runs -------------------------------------------------
+
+
+def test_run_recorder_and_get_runs(tmp_path):
+    from tpu_kubernetes.backend import LocalBackend
+    from tpu_kubernetes.config import Config
+    from tpu_kubernetes.get import format_runs, get_runs
+    from tpu_kubernetes.util.runlog import run_recorder
+    from tpu_kubernetes.util.trace import TRACER
+
+    backend = LocalBackend(tmp_path / "backend")
+    from tpu_kubernetes.state import State
+
+    backend.persist_state(State("dev"))  # so select_manager finds it
+
+    with run_recorder(backend, "dev", "create manager") as info:
+        with TRACER.phase("terraform apply", manager="dev"):
+            pass
+        info["cluster"] = "tpu-alpha"
+    with pytest.raises(RuntimeError):
+        with run_recorder(backend, "dev", "destroy manager"):
+            raise RuntimeError("exploded mid-apply")
+
+    cfg = Config({"cluster_manager": "dev"}, non_interactive=True, env={})
+    reports = get_runs(backend, cfg)
+    assert len(reports) == 2
+    ok, err = reports
+    assert ok["command"] == "create manager" and ok["status"] == "ok"
+    assert ok["cluster"] == "tpu-alpha"
+    assert ok["run_id"] and ok["run_id"] != err["run_id"]
+    assert [p["phase"] for p in ok["phases"]] == ["terraform apply"]
+    assert err["status"] == "error" and "exploded" in err["error"]
+
+    text = format_runs(reports)
+    assert "destroy manager" in text.splitlines()[0]  # newest first
+    assert "latest: destroy manager" in text
+    assert "error: exploded mid-apply" in text
+    assert format_runs([]) == "no recorded runs\n"
+
+
+def test_run_report_carries_tf_metrics(tmp_path):
+    from tpu_kubernetes.backend import LocalBackend
+    from tpu_kubernetes.shell.executor import TF_SECONDS
+    from tpu_kubernetes.util.runlog import run_recorder
+
+    backend = LocalBackend(tmp_path / "backend")
+    TF_SECONDS.labels("apply").observe(1.5)
+    with run_recorder(backend, "dev", "create manager"):
+        pass
+    report = backend.last_run_report("dev")
+    fam = report["metrics"]["tpu_tf_command_seconds"]
+    sample = next(
+        s for s in fam["samples"] if s["labels"] == {"command": "apply"}
+    )
+    assert sample["count"] >= 1

@@ -5,6 +5,7 @@ and reject malformed requests in-band."""
 import http.client
 import json
 import threading
+import time
 
 import jax
 import pytest
@@ -54,6 +55,70 @@ def test_models_listing(server):
     assert status == 200
     assert data["object"] == "list"
     assert data["data"][0]["id"] == "llama-test"
+
+
+def test_metrics_endpoint_prometheus_exposition(server):
+    # at least one real request so the counters have samples
+    status, _ = _request(
+        server, "POST", "/v1/completions",
+        {"prompt": "metrics probe", "max_new_tokens": 2},
+    )
+    assert status == 200
+
+    def scrape():
+        host, port = server.server_address[:2]
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        ctype = resp.getheader("Content-Type")
+        body = resp.read().decode()
+        conn.close()
+        assert resp.status == 200
+        assert ctype.startswith("text/plain; version=0.0.4")
+        return body
+
+    # request counters increment after the response bytes flush, so an
+    # immediate scrape can race the handler's finally-block — poll briefly
+    wanted = 'tpu_serve_requests_total{endpoint="/v1/completions",code="200"}'
+    deadline = time.monotonic() + 5
+    text = scrape()
+    while wanted not in text and time.monotonic() < deadline:
+        time.sleep(0.05)
+        text = scrape()
+
+    # every serving family is present from the first scrape, samples or not
+    for family, kind in (
+        ("tpu_serve_request_seconds", "histogram"),
+        ("tpu_serve_time_to_first_token_seconds", "histogram"),
+        ("tpu_serve_batch_queue_seconds", "histogram"),
+        ("tpu_serve_batch_size", "histogram"),
+        ("tpu_serve_requests_total", "counter"),
+        ("tpu_serve_tokens_generated_total", "counter"),
+        ("tpu_serve_prompt_tokens_total", "counter"),
+        ("tpu_serve_program_cache_total", "counter"),
+    ):
+        assert f"# TYPE {family} {kind}" in text
+
+    # the completion above must be visible in the request counter and the
+    # latency histogram (cumulative buckets end at +Inf == _count)
+    assert wanted in text
+    count_lines = [
+        line for line in text.splitlines()
+        if line.startswith('tpu_serve_request_seconds_count{endpoint="/v1/completions"}')
+    ]
+    assert count_lines and int(count_lines[0].split()[-1]) >= 1
+
+
+def test_healthz_reports_token_counters(server):
+    before = _request(server, "GET", "/healthz")[1]["metrics"]
+    status, _ = _request(
+        server, "POST", "/v1/completions",
+        {"prompt": "healthz probe", "max_new_tokens": 3},
+    )
+    assert status == 200
+    after = _request(server, "GET", "/healthz")[1]["metrics"]
+    assert after["tokens_generated"] >= before["tokens_generated"] + 3
+    assert after["prompt_tokens"] > before["prompt_tokens"]
 
 
 def test_completion_matches_library_greedy(server):

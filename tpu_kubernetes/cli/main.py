@@ -73,9 +73,21 @@ def build_parser() -> argparse.ArgumentParser:
     destroy.add_argument("kind", choices=["manager", "cluster", "node"])
 
     get = sub.add_parser(
-        "get", help="query a manager or cluster, or fetch a kubeconfig"
+        "get",
+        help="query a manager or cluster, fetch a kubeconfig, list "
+             "recorded workflow runs, or dump in-process metrics",
     )
-    get.add_argument("kind", choices=["manager", "cluster", "kubeconfig"])
+    get.add_argument(
+        "kind", choices=["manager", "cluster", "kubeconfig", "runs", "metrics"]
+    )
+    get.add_argument(
+        "--manager", metavar="NAME",
+        help="cluster manager to query (sugar for --set cluster_manager=NAME)",
+    )
+    get.add_argument(
+        "--json", dest="as_json", action="store_true",
+        help="with runs: dump every recorded report as JSON",
+    )
 
     repair = sub.add_parser(
         "repair",
@@ -113,6 +125,15 @@ def main(argv: list[str] | None = None) -> int:
         print(f"tpu-kubernetes v{tpu_kubernetes.__version__}")
         return 0
 
+    if args.command == "get" and args.kind == "metrics":
+        # this process's registry (terraform command families registered by
+        # the shell layer; families populate as workflows run in-process) —
+        # no backend or prompts needed, mirror of the server's GET /metrics
+        from tpu_kubernetes.obs import REGISTRY
+
+        print(REGISTRY.render(), end="")
+        return 0
+
     cfg = Config.load(args.config, non_interactive=args.non_interactive)
     for item in args.set:
         key, sep, value = item.partition("=")
@@ -120,6 +141,8 @@ def main(argv: list[str] | None = None) -> int:
             print(f"error: --set expects KEY=VALUE, got {item!r}", file=sys.stderr)
             return 2
         cfg.set(key, value)
+    if getattr(args, "manager", None):
+        cfg.set("cluster_manager", args.manager)
 
     try:
         backend = prompt_for_backend(cfg)
@@ -157,6 +180,12 @@ def main(argv: list[str] | None = None) -> int:
             if args.kind == "kubeconfig":
                 # raw YAML on stdout so `... get kubeconfig > kubeconfig` works
                 print(get_wf.get_kubeconfig(backend, cfg, executor), end="")
+            elif args.kind == "runs":
+                reports = get_wf.get_runs(backend, cfg)
+                if args.as_json:
+                    print(json.dumps(reports, indent=2, sort_keys=True))
+                else:
+                    print(get_wf.format_runs(reports), end="")
             else:
                 out = (
                     get_wf.get_manager(backend, cfg, executor)

@@ -1,5 +1,7 @@
 from tpu_kubernetes.get.workflows import (  # noqa: F401
+    format_runs,
     get_cluster,
     get_kubeconfig,
     get_manager,
+    get_runs,
 )

@@ -1,12 +1,15 @@
-"""``get manager|cluster`` workflows: query live outputs.
+"""``get manager|cluster|runs`` workflows: query live outputs and the
+recorded run history.
 
 reference: get/manager.go:16-96 and get/cluster.go:17-140 — render the state
 to a temp dir, ``terraform init`` + ``terraform output`` for the module of
-interest, print the result.
+interest, print the result. ``get runs`` has no reference analog: it reads
+the run reports persisted next to the state document (util/runlog.py).
 """
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 from tpu_kubernetes.backend import Backend
@@ -87,6 +90,57 @@ def get_cluster(backend: Backend, cfg: Config, executor: Executor) -> dict[str, 
         else:
             out = {**out, "node_health": diagnosis}
     return out
+
+
+def get_runs(backend: Backend, cfg: Config) -> list[dict[str, Any]]:
+    """Run reports for the selected manager, oldest first (the backend
+    orders by the ``runs/<millis>.json`` timestamp key). Each report is
+    what util/runlog.py persisted: command, status, run_id, phase
+    breakdown, and the terraform command metrics snapshot."""
+    manager = select_manager(backend, cfg)
+    return backend.run_reports(manager)
+
+
+def format_runs(reports: list[dict[str, Any]], history: int = 10) -> str:
+    """Human rendering: one summary line per run (newest first, capped at
+    ``history``), then the newest run's phase breakdown — the answer to
+    "what did the last create/destroy spend its time on"."""
+    if not reports:
+        return "no recorded runs\n"
+    lines = []
+    newest_first = list(reversed(reports))
+    for r in newest_first[:history]:
+        finished = r.get("finished_at")
+        when = (
+            time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(finished))
+            if isinstance(finished, (int, float)) else "?"
+        )
+        lines.append(
+            f"{when}  {r.get('command', '?'):<16} "
+            f"{r.get('status', '?'):<6} {r.get('total_seconds', 0.0):>8.1f}s"
+            f"  run_id={r.get('run_id', '-')}"
+        )
+    hidden = len(reports) - min(len(reports), history)
+    if hidden:
+        lines.append(f"… and {hidden} older run(s) — use --json for all")
+    last = newest_first[0]
+    lines.append("")
+    lines.append(
+        f"latest: {last.get('command', '?')} on "
+        f"{last.get('manager', '?')!r} — {last.get('status', '?')}"
+    )
+    for p in last.get("phases", []):
+        meta = {
+            k: v for k, v in p.items() if k not in ("phase", "seconds")
+        }
+        suffix = f"  {meta}" if meta else ""
+        lines.append(
+            f"  {p.get('phase', '?'):<24} {p.get('seconds', 0.0):>8.3f}s{suffix}"
+        )
+    error = last.get("error")
+    if error:
+        lines.append(f"  error: {error}")
+    return "\n".join(lines) + "\n"
 
 
 def get_kubeconfig(backend: Backend, cfg: Config, executor: Executor) -> str:

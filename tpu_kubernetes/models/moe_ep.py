@@ -270,7 +270,11 @@ def grouped_ep_mlp(cfg, y, gates, layer, mesh):
             axis=2,
         )
 
-    return jax.shard_map(
+    # function-level import: models ← parallel is the package-level
+    # dependency direction (parallel/pipeline imports models)
+    from tpu_kubernetes.parallel.compat import shard_map_compat
+
+    return shard_map_compat(
         inner,
         mesh=mesh,
         in_specs=(

@@ -1,0 +1,25 @@
+"""Unified observability: metrics registry + structured run events.
+
+One subsystem, two channels (SURVEY §5.1/§5.5 — the reference has
+neither):
+
+* :mod:`tpu_kubernetes.obs.metrics` — Prometheus-style ``Counter`` /
+  ``Gauge`` / ``Histogram`` families in a process-wide :data:`REGISTRY`,
+  scrape-ready via text exposition (``GET /metrics`` on the serve
+  server, ``tpu-k8s get metrics`` everywhere else).
+* :mod:`tpu_kubernetes.obs.events` — JSONL structured events with
+  run/correlation ids and nested parent spans (``TPU_K8S_EVENTS=<path>``
+  to enable), which util/trace.py phases feed.
+"""
+
+from tpu_kubernetes.obs.metrics import (  # noqa: F401
+    CONTENT_TYPE,
+    DEFAULT_BUCKETS,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricError,
+    Registry,
+)
+from tpu_kubernetes.obs import events  # noqa: F401

@@ -1,0 +1,167 @@
+"""Structured run events: a JSONL sink with correlation IDs and nesting.
+
+The human-facing channel is util/log (leveled stderr); the machine-facing
+channel is this one — append-only JSON lines, one event per line, each
+carrying:
+
+  ``run``    the run/correlation id (one workflow invocation, one HTTP
+             request, one training job) — set with :func:`run_context`
+  ``span``   this event's span id (span_start/span_end pairs share one)
+  ``parent`` the enclosing span's id, so nested phases reconstruct as a
+             tree (terraform init inside apply manager inside the run)
+
+The sink is disabled unless configured (``TPU_K8S_EVENTS=<path>`` or
+:func:`configure`), and it NEVER raises: observability must not fail a
+workflow (the util/runlog.py stance). Context flows through contextvars,
+so concurrent server threads and nested workflow phases each see their
+own run/parent without any plumbing through call signatures.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import io
+import json
+import os
+import threading
+import time
+import uuid
+
+_run_id: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "tpu_k8s_run_id", default=None
+)
+_parent_span: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "tpu_k8s_parent_span", default=None
+)
+
+
+def new_id() -> str:
+    """A short correlation id (12 hex chars — log-line friendly, and far
+    beyond collision range for per-process event streams)."""
+    return uuid.uuid4().hex[:12]
+
+
+def current_run_id() -> str | None:
+    return _run_id.get()
+
+
+def current_span_id() -> str | None:
+    return _parent_span.get()
+
+
+class EventSink:
+    """Thread-safe JSONL writer over a path or an open stream."""
+
+    def __init__(self, path: str | None = None, stream: io.IOBase | None = None):
+        self._path = path
+        self._stream = stream
+        self._lock = threading.Lock()
+
+    def write(self, event: dict) -> None:
+        line = json.dumps(event, sort_keys=True, default=str)
+        with self._lock:
+            if self._stream is not None:
+                self._stream.write(line + "\n")
+                self._stream.flush()
+            elif self._path is not None:
+                with open(self._path, "a", encoding="utf-8") as f:
+                    f.write(line + "\n")
+
+
+_sink: EventSink | None = None
+_sink_lock = threading.Lock()
+_env_checked = False
+
+
+def configure(path: str | None = None, stream=None) -> None:
+    """Install (or with no arguments, remove) the process event sink."""
+    global _sink, _env_checked
+    with _sink_lock:
+        _env_checked = True  # explicit configure overrides the env default
+        _sink = (
+            EventSink(path=path, stream=stream)
+            if path or stream is not None else None
+        )
+
+
+def _active_sink() -> EventSink | None:
+    global _sink, _env_checked
+    if not _env_checked:
+        with _sink_lock:
+            if not _env_checked:
+                path = os.environ.get("TPU_K8S_EVENTS")
+                if path:
+                    _sink = EventSink(path=path)
+                _env_checked = True
+    return _sink
+
+
+def emit(kind: str, **fields) -> None:
+    """Write one event; a no-op without a sink, and never raises."""
+    sink = _active_sink()
+    if sink is None:
+        return
+    event = {"ts": round(time.time(), 6), "kind": kind}
+    run = _run_id.get()
+    if run:
+        event["run"] = run
+    parent = _parent_span.get()
+    if parent:
+        event.setdefault("span", parent)
+    event.update(fields)
+    try:
+        sink.write(event)
+    except Exception:  # noqa: BLE001 — observability must not fail the caller
+        pass
+
+
+@contextlib.contextmanager
+def run_context(run_id: str | None = None):
+    """Scope a run/correlation id (new one when not given) over a block;
+    every event and span inside carries it. Yields the id."""
+    rid = run_id or new_id()
+    token = _run_id.set(rid)
+    try:
+        yield rid
+    finally:
+        _run_id.reset(token)
+
+
+@contextlib.contextmanager
+def parent_scope(span_id: str):
+    """Make ``span_id`` the parent for spans/events opened inside the
+    block — for callers (util/trace.py) that manage their own span
+    records but want their nesting visible here."""
+    token = _parent_span.set(span_id)
+    try:
+        yield
+    finally:
+        _parent_span.reset(token)
+
+
+@contextlib.contextmanager
+def span(name: str, **meta):
+    """A nested span: emits span_start/span_end events sharing one span
+    id, with the enclosing span as ``parent``. Yields the span id (which
+    becomes the parent for anything opened inside the block)."""
+    sid = new_id()
+    parent = _parent_span.get()
+    start = time.monotonic()
+    emit("span_start", span=sid, parent=parent, name=name, **meta)
+    token = _parent_span.set(sid)
+    try:
+        yield sid
+    except BaseException:
+        _parent_span.reset(token)
+        emit(
+            "span_end", span=sid, parent=parent, name=name,
+            seconds=round(time.monotonic() - start, 6), status="error", **meta,
+        )
+        raise
+    else:
+        _parent_span.reset(token)
+        emit(
+            "span_end", span=sid, parent=parent, name=name,
+            seconds=round(time.monotonic() - start, 6), status="ok", **meta,
+        )

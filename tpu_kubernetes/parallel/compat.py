@@ -1,0 +1,59 @@
+"""Version shims for shard_map-era jax APIs.
+
+The tree targets jax ≥ 0.8 — top-level ``jax.shard_map`` and the
+varying-type system (``jax.lax.pcast``). Older runtimes keep shard_map
+under ``jax.experimental`` and have no varying/invariant distinction at
+all: inside the mapped region every value is already per-shard, so
+``pcast`` degrades to identity there. Importing through this module keeps
+the rest of the package importable (and the serving/training stack
+bootable) on both sides of the API change.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax ≥ 0.8
+    from jax import shard_map  # noqa: F401
+except ImportError:  # pragma: no cover — jax < 0.8
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+if hasattr(jax.lax, "pcast"):  # jax ≥ 0.8
+    pcast = jax.lax.pcast
+else:  # pragma: no cover — no varying types pre-0.8; identity is exact
+    def pcast(x, axes, to="varying"):
+        del axes, to
+        return x
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs,
+                     axis_names=frozenset(), check_vma=True):
+    """Front-end for the full jax ≥ 0.8 shard_map surface (``axis_names``
+    manual-axis subsetting, ``check_vma``). On older jax, ``axis_names``
+    maps to the experimental API's complement (``auto`` = the mesh axes
+    left automatic) and ``check_vma`` to ``check_rep``."""
+    if hasattr(jax, "shard_map"):  # jax ≥ 0.8
+        kwargs = {"check_vma": check_vma}
+        if axis_names:
+            kwargs["axis_names"] = set(axis_names)
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    auto = (
+        frozenset(mesh.axis_names) - frozenset(axis_names)
+        if axis_names else frozenset()
+    )
+    if auto:
+        # Partial-manual mode on this runtime lowers through a PartitionId
+        # instruction XLA's SPMD partitioner hard-aborts on (killing the
+        # whole process, not raising). Refuse catchably instead.
+        raise NotImplementedError(
+            "partial-manual shard_map (axis_names subsetting) requires "
+            f"jax >= 0.8; mesh axes {sorted(mesh.axis_names)} with manual "
+            f"axes {sorted(axis_names)} cannot compile on jax "
+            f"{jax.__version__}"
+        )
+    return shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )

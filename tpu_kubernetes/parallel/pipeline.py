@@ -32,11 +32,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from jax import shard_map  # requires jax ≥ 0.8 (pcast below does too)
-
 from tpu_kubernetes.models import ModelConfig
 from tpu_kubernetes.models.llama import _block, remat_policy_kwargs
 from tpu_kubernetes.ops import next_token_nll, rms_norm, rope_frequencies
+from tpu_kubernetes.parallel.compat import pcast, shard_map
 from tpu_kubernetes.parallel.mesh import (
     DEFAULT_RULES,
     data_axes_in,
@@ -67,8 +66,8 @@ def _pipeline_body(
     # the carry becomes stage-varying (ingest depends on axis_index) and
     # data-varying (microbatches are data-sharded) inside the loop; mark
     # the initial values varying so the loop types are stable
-    act0 = jax.lax.pcast(act0, (stage_axis, *data_axes), to="varying")
-    buf0 = jax.lax.pcast(buf0, (stage_axis,), to="varying")  # data-varying already
+    act0 = pcast(act0, (stage_axis, *data_axes), to="varying")
+    buf0 = pcast(buf0, (stage_axis,), to="varying")  # data-varying already
     fwd = [(i, i + 1) for i in range(n_stages - 1)]  # non-cyclic shift
 
     def tick(carry, t):

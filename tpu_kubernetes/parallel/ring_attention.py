@@ -21,7 +21,8 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
-from jax import shard_map  # requires jax ≥ 0.8 (pcast below does too)
+
+from tpu_kubernetes.parallel.compat import pcast, shard_map
 
 NEG_INF = -1e30
 
@@ -91,7 +92,7 @@ def ring_attention(
     acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
     # the carry becomes device-varying inside the loop; mark the initial
     # values as varying over the ring axis so the loop types are stable
-    m0, l0, acc0 = jax.lax.pcast((m0, l0, acc0), (axis_name,), to='varying')
+    m0, l0, acc0 = pcast((m0, l0, acc0), (axis_name,), to='varying')
     m, l, acc, _, _ = jax.lax.fori_loop(
         0, n, step, (m0, l0, acc0, k.astype(jnp.float32), v.astype(jnp.float32))
     )

@@ -5,7 +5,14 @@ A minimal stdlib server (zero dependencies, air-gap friendly) exposing:
 
   GET  /healthz            → {"status": "ok", "model": ..., ...}
                              (readiness probe; returns 503 until the
-                             first compile has finished warming)
+                             first compile has finished warming; carries
+                             a one-glance metrics summary)
+  GET  /metrics            → Prometheus text exposition of the process
+                             registry (obs/metrics.py): request-latency /
+                             TTFT / batch queue-wait / batch-size
+                             histograms, tokens-generated and speculation
+                             counters, compile-cache hits — scrape-ready
+                             (docs/guide/observability.md)
   GET  /v1/models          → the one resident model, OpenAI-list shaped
   POST /v1/completions     → {"prompt": str, "max_new_tokens"?: int,
                               "temperature"?: float, "top_k"?: int,
@@ -82,6 +89,7 @@ completes provision → import weights → quantize → serve-over-HTTP.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sys
@@ -90,9 +98,66 @@ import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from tpu_kubernetes.obs import REGISTRY, events
+from tpu_kubernetes.obs import metrics as obs_metrics
+from tpu_kubernetes.util import log
 
-def log(*args) -> None:
-    print("[server]", *args, file=sys.stderr, flush=True)
+# -- serving telemetry (obs/metrics.py): registered at import so every
+# family is present in GET /metrics from the first scrape, samples or not.
+_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 10.0, 30.0, 60.0,
+)
+REQUEST_SECONDS = REGISTRY.histogram(
+    "tpu_serve_request_seconds",
+    "end-to-end request latency by endpoint",
+    labelnames=("endpoint",), buckets=_LATENCY_BUCKETS,
+)
+TTFT_SECONDS = REGISTRY.histogram(
+    "tpu_serve_time_to_first_token_seconds",
+    "streaming requests: receipt to first emitted piece",
+    buckets=_LATENCY_BUCKETS,
+)
+QUEUE_SECONDS = REGISTRY.histogram(
+    "tpu_serve_batch_queue_seconds",
+    "dynamic batching: enqueue to dispatch wait",
+    buckets=_LATENCY_BUCKETS,
+)
+BATCH_SIZE = REGISTRY.histogram(
+    "tpu_serve_batch_size",
+    "dynamic batching: rows per dispatched batch",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0),
+)
+REQUESTS_TOTAL = REGISTRY.counter(
+    "tpu_serve_requests_total",
+    "HTTP requests served, by endpoint and status code",
+    labelnames=("endpoint", "code"),
+)
+TOKENS_GENERATED = REGISTRY.counter(
+    "tpu_serve_tokens_generated_total",
+    "completion tokens emitted (warm-up excluded)",
+)
+PROMPT_TOKENS = REGISTRY.counter(
+    "tpu_serve_prompt_tokens_total",
+    "prompt tokens consumed (warm-up excluded)",
+)
+SPEC_ROUNDS = REGISTRY.counter(
+    "tpu_serve_spec_rounds_total",
+    "prompt-lookup speculation: target passes (prefill included)",
+)
+SPEC_DRAFTED = REGISTRY.counter(
+    "tpu_serve_spec_drafted_total",
+    "prompt-lookup speculation: tokens proposed",
+)
+SPEC_ACCEPTED = REGISTRY.counter(
+    "tpu_serve_spec_accepted_total",
+    "prompt-lookup speculation: proposed tokens the target kept",
+)
+PROGRAM_CACHE = REGISTRY.counter(
+    "tpu_serve_program_cache_total",
+    "compiled-program cache lookups (miss = a fresh jit wrapper)",
+    labelnames=("result",),
+)
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -146,7 +211,7 @@ class _Batcher:
 
     def submit(self, ids: list, max_new: int) -> list:
         entry = {
-            "ids": ids, "max_new": max_new,
+            "ids": ids, "max_new": max_new, "t_enq": time.monotonic(),
             "event": threading.Event(), "tokens": None, "error": None,
         }
         with self._cond:
@@ -190,6 +255,12 @@ class _Batcher:
                 batch, rest = pending, []    # taints the whole round
                 err = e
             else:
+                # queue-wait = enqueue → dispatch (the latency cost of
+                # waiting for co-riders); batch size = rows that co-rode
+                now = time.monotonic()
+                for entry in batch:
+                    QUEUE_SECONDS.observe(now - entry["t_enq"])
+                BATCH_SIZE.observe(len(batch))
                 try:
                     self._run_batch(batch)
                 except Exception as e:  # noqa: BLE001 — fan the error out
@@ -229,7 +300,12 @@ class ServingState:
         self.prompt_lookup = truthy_env(env, "SERVE_PROMPT_LOOKUP")
         self.draft_k = int(env.get("SERVE_DRAFT_K", "8"))
         self.ngram = int(env.get("SERVE_NGRAM", "2"))
+        # cumulative speculation totals: written by batcher-dispatch /
+        # handler threads (the _lookup_rounds finally), read by /healthz
+        # handler threads — same lock discipline as the metrics registry
+        # (one mutex guarding the shared numbers, held only for the update)
         self.spec_totals = {"rounds": 0, "drafted": 0, "accepted": 0}
+        self._spec_lock = threading.Lock()
         eos_env = env.get("SERVE_EOS_ID", "")
         self.eos_id = int(eos_env) if eos_env else None
         self.model_name = env.get("SERVE_HF_CHECKPOINT", "") or env.get(
@@ -282,7 +358,7 @@ class ServingState:
             self.params = jax.device_put(
                 params, serving_param_shardings(params, cfg, self.mesh)
             )
-            log(f"sharded serving: mesh={dict(self.mesh.shape)}")
+            log.info(f"server: sharded serving: mesh={dict(self.mesh.shape)}")
         # jitted programs keyed by their STATIC arguments — jax.jit's own
         # cache keys on callable identity, so a fresh partial per request
         # would re-trace+compile every time. Handler threads race on
@@ -324,8 +400,8 @@ class ServingState:
             # the ragged-row identity batching leans on is weaker for MoE
             # (capacity is computed at the padded width — co-riders could
             # change a response); serve MoE solo rather than quietly
-            log("SERVER_BATCH ignored: MoE capacity is batch-width-"
-                "dependent, dynamic batching could change responses")
+            log.warn("SERVER_BATCH ignored: MoE capacity is batch-width-"
+                     "dependent, dynamic batching could change responses")
         elif batch > 1:
             def fits(selected: list, entry: dict) -> bool:
                 width = _bucket(max(
@@ -357,7 +433,7 @@ class ServingState:
             for _ in self.stream(""):
                 pass
         self.ready = True
-        log("warm: default programs compiled, serving")
+        log.info("server: warm — default programs compiled, serving")
 
     def _cached_program(self, key, build):
         """Get-or-create a jitted program under the cache mutex. The
@@ -365,6 +441,7 @@ class ServingState:
         happens at first call, serialized by the generation lock."""
         with self._programs_lock:
             fn = self._programs.get(key)
+            PROGRAM_CACHE.labels("hit" if fn is not None else "miss").inc()
             if fn is None:
                 fn = self._programs[key] = build()
         return fn
@@ -574,9 +651,16 @@ class ServingState:
         finally:
             # finally: a streaming disconnect closes this generator at a
             # yield — the work done must still reach the totals
-            self.spec_totals["rounds"] += rounds + 1   # +1: the prefill
-            self.spec_totals["drafted"] += drafted
-            self.spec_totals["accepted"] += accepted
+            with self._spec_lock:
+                self.spec_totals["rounds"] += rounds + 1   # +1: the prefill
+                self.spec_totals["drafted"] += drafted
+                self.spec_totals["accepted"] += accepted
+            SPEC_ROUNDS.inc(rounds + 1)
+            SPEC_DRAFTED.inc(drafted)
+            SPEC_ACCEPTED.inc(accepted)
+            if self.ready:
+                TOKENS_GENERATED.inc(len(emitted))
+                PROMPT_TOKENS.inc(len(ids))
             if finish is not None:
                 finish["spec"] = {
                     "rounds": rounds + 1, "drafted": drafted,
@@ -657,6 +741,11 @@ class ServingState:
         tokens = tokens[:max_new]              # bucketed run → requested budget
         if self.eos_id is not None and self.eos_id in tokens:
             tokens = tokens[:tokens.index(self.eos_id)]
+        if spec is None and self.ready:
+            # ready-gated so warm-up traffic doesn't pollute the counters;
+            # the lookup path already counted inside _lookup_rounds
+            TOKENS_GENERATED.inc(len(tokens))
+            PROMPT_TOKENS.inc(len(ids))
         result = {
             "text": self.decode_text(tokens),
             "tokens": len(tokens),
@@ -749,6 +838,8 @@ class ServingState:
             if run_max_new > 1 else None
         )
         def tokens():
+            if self.ready:
+                PROMPT_TOKENS.inc(len(ids))
             logits, cache = pf(
                 self.params, jnp.asarray(padded),
                 lengths=jnp.asarray([len(ids)], jnp.int32),
@@ -763,6 +854,8 @@ class ServingState:
                     if finish is not None:
                         finish["reason"] = "stop"
                     return
+                if self.ready:
+                    TOKENS_GENERATED.inc()
                 yield [t]
                 if i + 1 == max_new:
                     if finish is not None:
@@ -778,10 +871,20 @@ class _Handler(BaseHTTPRequestHandler):
     state: ServingState  # set by make_server
     protocol_version = "HTTP/1.1"  # chunked transfer needs 1.1
 
-    def log_message(self, fmt, *args):  # route through our logger
-        log(self.address_string(), fmt % args)
+    # the bounded endpoint-label vocabulary: anything else is "other" so a
+    # path-scanning client can't mint unbounded label cardinality
+    _ENDPOINTS = frozenset({
+        "/healthz", "/metrics", "/v1/models",
+        "/v1/completions", "/v1/chat/completions",
+    })
+
+    def log_message(self, fmt, *args):
+        # per-request access lines are verbose-level detail: util/log makes
+        # -q/--verbose apply to the serving path like everywhere else
+        log.debug(f"server: {self.address_string()} {fmt % args}")
 
     def _json(self, code: int, obj: dict) -> None:
+        self._code = code
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -790,6 +893,34 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        with self._observed():
+            self._get()
+
+    def do_POST(self):  # noqa: N802
+        # each request is one correlated run: events it emits (the closing
+        # summary below included) share one id, greppable in the JSONL stream
+        with events.run_context(), self._observed():
+            self._post()
+            events.emit("http_request", path=self.path,
+                        code=getattr(self, "_code", 0))
+
+    @contextlib.contextmanager
+    def _observed(self):
+        """Count + time this request into the registry whichever way the
+        handler exits (the status code is whatever _json/_stream_sse last
+        wrote; a handler crash counts as 500)."""
+        endpoint = self.path if self.path in self._ENDPOINTS else "other"
+        self._code = 500
+        self._t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            REQUESTS_TOTAL.labels(endpoint, str(self._code)).inc()
+            REQUEST_SECONDS.labels(endpoint).observe(
+                time.monotonic() - self._t0
+            )
+
+    def _get(self):  # noqa: C901 — one dispatch ladder
         st = self.state
         if self.path == "/v1/models":
             # the OpenAI-client handshake: one resident model
@@ -797,6 +928,17 @@ class _Handler(BaseHTTPRequestHandler):
                 "object": "list",
                 "data": [{"id": st.model_name, "object": "model"}],
             })
+        if self.path == "/metrics":
+            # Prometheus text exposition of the process registry — serving
+            # histograms/counters plus whatever else this process recorded
+            body = REGISTRY.render().encode("utf-8")
+            self._code = 200
+            self.send_response(200)
+            self.send_header("Content-Type", obs_metrics.CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return None
         if self.path != "/healthz":
             return self._json(404, {"error": "unknown path"})
         if not st.ready:
@@ -806,9 +948,16 @@ class _Handler(BaseHTTPRequestHandler):
             "model": st.model_name,
             "max_new_tokens_cap": st.max_new_cap,
             "kv_quant": st.kv_quant,
+            # the one-glance operational summary; the full families (and
+            # everything per-label) live at GET /metrics
+            "metrics": {
+                "tokens_generated": int(TOKENS_GENERATED.value),
+                "prompt_tokens": int(PROMPT_TOKENS.value),
+            },
         }
         if st.prompt_lookup:
-            t = st.spec_totals
+            with st._spec_lock:
+                t = dict(st.spec_totals)
             body["prompt_lookup"] = {
                 "draft_k": st.draft_k, "ngram": st.ngram,
                 "drafted": t["drafted"], "accepted": t["accepted"],
@@ -838,7 +987,7 @@ class _Handler(BaseHTTPRequestHandler):
         parts.append("assistant:")
         return "\n".join(parts)
 
-    def do_POST(self):  # noqa: N802
+    def _post(self):
         chat = self.path == "/v1/chat/completions"
         if self.path != "/v1/completions" and not chat:
             return self._json(404, {"error": "unknown path"})
@@ -878,6 +1027,7 @@ class _Handler(BaseHTTPRequestHandler):
                 finish: dict = {}
                 pieces = self.state.stream(prompt, finish=finish, **kwargs)
                 first = next(pieces, None)
+                TTFT_SECONDS.observe(time.monotonic() - self._t0)
                 return self._stream_sse(
                     first, pieces, chat=chat, finish=finish
                 )
@@ -931,7 +1081,7 @@ class _Handler(BaseHTTPRequestHandler):
                     q.put(piece)
                 q.put(None)
             except Exception as e:  # noqa: BLE001 — surfaced via sentinel
-                log(f"stream producer failed: {type(e).__name__}: {e}")
+                log.warn(f"stream producer failed: {type(e).__name__}: {e}")
                 q.put(_FAILED)
 
         producer = None
@@ -945,6 +1095,7 @@ class _Handler(BaseHTTPRequestHandler):
             # gone before the status line still suspends the stream()
             # generator inside the generation lock, and only the finally
             # below releases it deterministically
+            self._code = 200
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -964,7 +1115,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # NO [DONE] and NO terminal chunk: aborting the chunked
                 # body is the in-band error signal — a clean EOF would
                 # make a truncated completion look like a successful one
-                log("aborting stream after mid-generation failure")
+                log.warn("aborting stream after mid-generation failure")
                 self.close_connection = True
                 self.wfile.flush()
             else:
@@ -986,7 +1137,7 @@ class _Handler(BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionResetError):
             # client went away mid-stream; the producer finishes its
             # bounded work and releases the lock on its own
-            log("client disconnected mid-stream")
+            log.info("server: client disconnected mid-stream")
         finally:
             if producer is not None:
                 producer.join()
@@ -1041,8 +1192,21 @@ def make_server(env: dict | None = None) -> ThreadingHTTPServer:
     return ThreadingHTTPServer((host, port), handler)
 
 
-def main() -> int:
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
     from tpu_kubernetes.parallel import read_env
+
+    # the CLI's verbosity contract applies to the serving path too: one
+    # leveled logger (util/log), so -q silences progress and --verbose
+    # surfaces per-request access lines, uniformly with tpu-k8s itself
+    parser = argparse.ArgumentParser(prog="tpu-kubernetes-server")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="warnings and errors only")
+    parser.add_argument("--verbose", action="store_true",
+                        help="debug detail (per-request access lines)")
+    args = parser.parse_args(argv)
+    log.set_verbosity(quiet=args.quiet, verbose=args.verbose)
 
     denv = read_env()
     if denv.multi_host:
@@ -1063,7 +1227,7 @@ def main() -> int:
         # one-line diagnostics, not tracebacks — the batch job's stance
         raise SystemExit(f"config error: {e}") from e
     host, port = server.server_address[:2]
-    log(f"listening on {host}:{port}")
+    log.info(f"server: listening on {host}:{port}")
     try:
         server.serve_forever()
     except KeyboardInterrupt:

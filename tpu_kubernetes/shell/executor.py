@@ -17,19 +17,50 @@ Two implementations of one :class:`Executor` protocol:
 from __future__ import annotations
 
 import abc
+import contextlib
 import json
 import os
 import shutil
 import subprocess
 import tempfile
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Sequence
 
+from tpu_kubernetes.obs import REGISTRY
 from tpu_kubernetes.state import State
 from tpu_kubernetes.util.trace import TRACER, Tracer
 
 STATE_FILE = "main.tf.json"
+
+# terraform command telemetry (persisted into run reports, util/runlog.py):
+# init/apply/destroy/output durations and failure counts are THE create→
+# first-train-step latency breakdown the ROADMAP optimizes for
+TF_SECONDS = REGISTRY.histogram(
+    "tpu_tf_command_seconds",
+    "terraform command wall time by subcommand",
+    labelnames=("command",),
+)
+TF_FAILURES = REGISTRY.counter(
+    "tpu_tf_failures_total",
+    "terraform commands that exited nonzero (or failed to spawn)",
+    labelnames=("command",),
+)
+
+
+@contextlib.contextmanager
+def _tf_timed(command: str):
+    """Time one terraform subcommand into the registry; failures count by
+    subcommand so flaky applies are distinguishable from broken inits."""
+    t0 = time.monotonic()
+    try:
+        yield
+    except Exception:
+        TF_FAILURES.labels(command).inc()
+        raise
+    finally:
+        TF_SECONDS.labels(command).observe(time.monotonic() - t0)
 
 
 class ExecutorError(Exception):
@@ -85,10 +116,15 @@ class TerraformExecutor(Executor):
         deadline enforcement and an output tail in errors), else plain
         subprocess."""
         cmd = [self.terraform_bin, *args]
-        from tpu_kubernetes import native
         from tpu_kubernetes.util import log
 
         log.debug(f"exec: {' '.join(cmd)} (cwd {cwd})")
+        with _tf_timed(args[0]):
+            self._run_inner(cmd, cwd)
+
+    def _run_inner(self, cmd: list[str], cwd: Path) -> None:
+        from tpu_kubernetes import native
+
         if native.available():
             code, tail = native.run_streaming(
                 cmd, cwd=cwd, timeout_s=self.timeout_s,
@@ -139,11 +175,12 @@ class TerraformExecutor(Executor):
 
     def _capture(self, args: Sequence[str], cwd: Path) -> str:
         cmd = [self.terraform_bin, *args]
-        proc = subprocess.run(cmd, cwd=cwd, capture_output=True, text=True)
-        if proc.returncode != 0:
-            raise ExecutorError(
-                f"{' '.join(cmd)} exited with status {proc.returncode}\n{proc.stderr}"
-            )
+        with _tf_timed(args[0]):
+            proc = subprocess.run(cmd, cwd=cwd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise ExecutorError(
+                    f"{' '.join(cmd)} exited with status {proc.returncode}\n{proc.stderr}"
+                )
         return proc.stdout
 
     def apply(self, state: State, targets: Sequence[str] = ()) -> None:

@@ -9,10 +9,19 @@ analog of MaxText's checkpointing.
 
 from __future__ import annotations
 
+import time
 from pathlib import Path
 from typing import Any
 
 import jax
+
+from tpu_kubernetes.obs import REGISTRY
+
+CKPT_SECONDS = REGISTRY.histogram(
+    "tpu_train_checkpoint_seconds",
+    "checkpoint save/restore wall time",
+    labelnames=("op",),
+)
 
 
 class CheckpointError(Exception):
@@ -47,11 +56,13 @@ def _manager(directory: str | Path, max_to_keep: int = 3):
 def save(directory: str | Path, state: dict[str, Any], step: int,
          max_to_keep: int = 3, wait: bool = True) -> None:
     ocp = _import_ocp()
+    t0 = time.monotonic()
     mgr = _manager(directory, max_to_keep)
     mgr.save(step, args=ocp.args.StandardSave(state))
     if wait:
         mgr.wait_until_finished()
     mgr.close()
+    CKPT_SECONDS.labels("save").observe(time.monotonic() - t0)
 
 
 def latest_step(directory: str | Path) -> int | None:
@@ -66,6 +77,7 @@ def restore(directory: str | Path, like: dict[str, Any],
     """Restore into the structure/shardings of ``like`` (an abstract or
     concrete train state)."""
     ocp = _import_ocp()
+    t0 = time.monotonic()
     mgr = _manager(directory)
     if step is None:
         step = mgr.latest_step()
@@ -74,4 +86,5 @@ def restore(directory: str | Path, like: dict[str, Any],
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
     restored = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
     mgr.close()
+    CKPT_SECONDS.labels("restore").observe(time.monotonic() - t0)
     return restored

@@ -171,19 +171,25 @@ def main() -> None:
         mean = total / eval_batches
         log(f"eval step={at_step} loss={mean:.4f} ppl={math.exp(min(mean, 30)):.2f}")
 
+    from tpu_kubernetes.train.trainer import FIRST_STEP_SECONDS, observe_steps
+
     first_step_done = False
     t_last = time.time()
     for i in range(start_step, steps):
         state, loss = step_fn(state, next(batches))
         if not first_step_done:
             jax.block_until_ready(loss)
-            log(f"FIRST TRAIN STEP at +{time.time() - t_start:.1f}s "
+            first_step_s = time.time() - t_start
+            FIRST_STEP_SECONDS.set(first_step_s)
+            log(f"FIRST TRAIN STEP at +{first_step_s:.1f}s "
                 f"loss={float(loss):.4f}")   # the north-star latency marker
             first_step_done = True
         if (i + 1) % 10 == 0:
             jax.block_until_ready(loss)
             now = time.time()
             tps = 10 * batch * seq / (now - t_last)
+            observe_steps(now - t_last, 10, 10 * batch * seq,
+                          loss=float(loss))
             log(f"step={i + 1} loss={float(loss):.4f} tokens/s={tps:.0f}")
             t_last = now
         if eval_path and (i + 1) % eval_every == 0:

@@ -3,8 +3,14 @@
 The reference has no observability beyond stdout (SURVEY §5.1/§5.5), yet the
 north-star metric is create→first-train-step latency — so every workflow
 records its phase breakdown (render/validate/apply/…) as a run report next
-to the state document (``runs/<millis>.json``), where ``get manager``
-surfaces the latest one.
+to the state document (``runs/<millis>.json``), where ``get manager`` and
+``get runs`` surface it.
+
+Each report also carries the run/correlation id (the same id on every
+structured event the run emitted, obs/events.py) and a snapshot of the
+terraform command metrics (durations + failure counts, shell/executor.py)
+accumulated in this process — so a single report answers both "what did
+this run spend its time on" and "how has terraform been behaving here".
 """
 
 from __future__ import annotations
@@ -13,7 +19,12 @@ import contextlib
 import time
 from typing import Any
 
+from tpu_kubernetes.obs import REGISTRY, events
 from tpu_kubernetes.util.trace import TRACER
+
+# the metric families snapshotted into run reports (the terraform layer —
+# per-run phases already cover the workflow itself)
+REPORT_METRIC_PREFIX = "tpu_tf_"
 
 
 def record_run(
@@ -22,6 +33,7 @@ def record_run(
     command: str,
     since: int,
     status: str = "ok",
+    run_id: str | None = None,
     **extra: Any,
 ) -> None:
     """Write a run report; never let observability break the workflow."""
@@ -30,11 +42,19 @@ def record_run(
         "command": command,
         "manager": manager,
         "status": status,
+        "run_id": run_id or events.current_run_id() or events.new_id(),
         "finished_at": time.time(),
         "total_seconds": round(sum(p["seconds"] for p in phases), 3),
         "phases": phases,
         **extra,
     }
+    metrics = {
+        name: fam
+        for name, fam in REGISTRY.snapshot(prefix=REPORT_METRIC_PREFIX).items()
+        if fam["samples"]  # dry runs register families but never sample them
+    }
+    if metrics:
+        report["metrics"] = metrics
     try:
         backend.persist_run_report(manager, report)
     except Exception as e:  # noqa: BLE001 — observability must not fail a run
@@ -48,12 +68,23 @@ def run_recorder(backend: Any, manager: str, command: str, **extra: Any):
     """Record the run whichever way it ends: failed runs are exactly the
     ones worth inspecting in ``get manager``, so an exception records
     ``status: error`` (with the phases that did complete) and re-raises.
-    Yields a dict the workflow may add extras to (cluster=…, nodes=…)."""
+    Yields a dict the workflow may add extras to (cluster=…, nodes=…).
+
+    The whole block runs under one run/correlation id: every phase span
+    and structured event inside carries it, and the persisted report
+    names it — grep the JSONL event stream by that id to replay the run.
+    """
     mark = TRACER.mark()
     info = dict(extra)
-    try:
-        yield info
-    except BaseException:
-        record_run(backend, manager, command, mark, status="error", **info)
-        raise
-    record_run(backend, manager, command, mark, **info)
+    with events.run_context() as rid:
+        events.emit("run_start", command=command, manager=manager)
+        try:
+            yield info
+        except BaseException as e:
+            events.emit("run_end", command=command, manager=manager,
+                        status="error", error=str(e)[:200])
+            record_run(backend, manager, command, mark, status="error",
+                       run_id=rid, error=str(e)[:200], **info)
+            raise
+        events.emit("run_end", command=command, manager=manager, status="ok")
+        record_run(backend, manager, command, mark, run_id=rid, **info)
