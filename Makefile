@@ -3,7 +3,7 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast obs-check bench dryrun native dist dist-offline clean
+.PHONY: test test-fast obs-check monitor-check bench dryrun native dist dist-offline clean
 
 test:
 	python -m pytest tests/ -q
@@ -16,13 +16,23 @@ native:
 test-fast:
 	python -m pytest tests/ -q -m "not slow"
 
-# Fast observability smoke: registry/events/tracer units plus a live CPU
-# server boot that scrapes GET /metrics (docs/guide/observability.md).
+# Fast observability smoke: registry/events/tracer/exposition units, the
+# fleet aggregator + SLO suite, plus a live CPU server boot that scrapes
+# GET /metrics and walks /debug/trace (docs/guide/observability.md).
 obs-check:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py \
+	  tests/test_expfmt.py tests/test_fleet_obs.py \
 	  "tests/test_server.py::test_metrics_endpoint_prometheus_exposition" \
 	  "tests/test_server.py::test_healthz_reports_token_counters" \
+	  "tests/test_server.py::test_request_id_on_every_response" \
+	  "tests/test_server.py::test_inbound_request_id_echoed_and_traced" \
 	  -q -m "not slow"
+
+# Fleet monitoring smoke: boots two in-process metrics servers, runs
+# `monitor --once --json` against both, and asserts one merged snapshot
+# with both instance labels (the ISSUE acceptance path).
+monitor-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_obs.py -q -m "not slow"
 
 bench:
 	python bench.py

@@ -1,0 +1,145 @@
+"""Exposition round-trip contract: obs/expfmt.py must parse everything
+obs/metrics.py emits and re-render it byte-identically — the fleet
+aggregator re-exposes scraped numbers, so any drift would corrupt the
+merged view."""
+
+import math
+
+import pytest
+
+from tpu_kubernetes.obs import expfmt
+from tpu_kubernetes.obs.metrics import Registry
+
+
+def _busy_registry() -> Registry:
+    """One of everything the emitter can produce: labeled/unlabeled
+    counters, a gauge, histograms (+Inf bucket, float sums), label
+    values needing every escape, and a registered-but-never-sampled
+    labeled family."""
+    reg = Registry()
+    c = reg.counter("jobs_total", "jobs processed",
+                    labelnames=("kind", "status"))
+    c.labels("train", "ok").inc(3)
+    c.labels("serve", "error").inc()
+    reg.gauge("queue_depth", "requests waiting").set(7)
+    h = reg.histogram("latency_seconds", "request latency",
+                      buckets=(0.1, 0.5, 1.0))
+    for v in (0.05, 0.3, 0.7, 2.0):
+        h.observe(v)
+    esc = reg.counter("weird_total", "escape gauntlet",
+                      labelnames=("path",))
+    esc.labels('a\\b"c\nd').inc()
+    reg.counter("unsampled_total", "registered but never incremented",
+                labelnames=("kind",))
+    reg.counter("bare_total", "").inc(2)  # empty help line
+    return reg
+
+
+def test_round_trip_byte_identical():
+    text = _busy_registry().render()
+    assert expfmt.render(expfmt.parse(text)) == text
+
+
+def test_empty_registry_round_trips():
+    text = Registry().render()
+    assert text == ""
+    assert expfmt.parse(text) == []
+    assert expfmt.render([]) == ""
+
+
+def test_double_round_trip_is_stable():
+    # parse(render(parse(x))) must not drift either
+    text = _busy_registry().render()
+    once = expfmt.render(expfmt.parse(text))
+    assert expfmt.render(expfmt.parse(once)) == once
+
+
+def test_parse_structure():
+    fams = {f.name: f for f in expfmt.parse(_busy_registry().render())}
+    jobs = fams["jobs_total"]
+    assert jobs.kind == "counter" and jobs.help == "jobs processed"
+    by_labels = {s.labels: s.value for s in jobs.samples}
+    assert by_labels[(("kind", "serve"), ("status", "error"))] == 1
+    assert by_labels[(("kind", "train"), ("status", "ok"))] == 3
+
+    lat = fams["latency_seconds"]
+    assert lat.kind == "histogram"
+    # _bucket/_sum/_count rows all land under the declaring family
+    names = {s.name for s in lat.samples}
+    assert names == {"latency_seconds_bucket", "latency_seconds_sum",
+                     "latency_seconds_count"}
+    inf_bucket = next(
+        s for s in lat.samples
+        if s.name == "latency_seconds_bucket"
+        and s.labels_dict()["le"] == "+Inf"
+    )
+    assert inf_bucket.value == 4
+    count = next(s for s in lat.samples
+                 if s.name == "latency_seconds_count")
+    assert count.value == 4
+
+    # registered-but-unsampled labeled family: headers survive, no rows
+    assert fams["unsampled_total"].samples == []
+    assert fams["bare_total"].help == ""
+
+
+def test_label_escaping_survives_round_trip():
+    fams = expfmt.parse(_busy_registry().render())
+    weird = next(f for f in fams if f.name == "weird_total")
+    assert weird.samples[0].labels_dict()["path"] == 'a\\b"c\nd'
+
+
+def test_with_label_appends_preserving_order():
+    s = expfmt.Sample("x_total", (("a", "1"),), 2.0)
+    tagged = s.with_label("instance", "h:8000")
+    assert tagged.labels == (("a", "1"), ("instance", "h:8000"))
+    assert s.labels == (("a", "1"),)  # original untouched
+    assert expfmt.render_sample(tagged) == (
+        'x_total{a="1",instance="h:8000"} 2'
+    )
+
+
+def test_value_formatting_matches_emitter():
+    assert expfmt.format_value(3.0) == "3"
+    assert expfmt.format_value(0.25) == "0.25"
+    assert expfmt.format_value(math.inf) == "+Inf"
+    assert expfmt.format_value(-math.inf) == "-Inf"
+    assert expfmt.parse_value("+Inf") == math.inf
+    assert expfmt.parse_value("-Inf") == -math.inf
+    assert expfmt.parse_value("1e3") == 1000.0
+
+
+def test_tolerates_foreign_exposition():
+    # untyped samples, stray comments, and trailing timestamps are all
+    # legal exposition from other exporters — parsed, not fatal
+    fams = expfmt.parse(
+        "# a free-form comment\n"
+        "no_headers_metric 4\n"
+        'stamped{x="y"} 1.5 1712345678\n'
+    )
+    by_name = {f.name: f for f in fams}
+    assert by_name["no_headers_metric"].kind == "untyped"
+    assert by_name["no_headers_metric"].samples[0].value == 4
+    assert by_name["stamped"].samples[0].value == 1.5
+
+
+@pytest.mark.parametrize("line", [
+    "garbage that is not exposition",
+    "name_only",
+    'x{y="unterminated} 1',
+    'x{no_equals} 1',
+])
+def test_malformed_lines_raise(line):
+    with pytest.raises(expfmt.ParseError):
+        expfmt.parse(line + "\n")
+
+
+def test_bucket_quantile_interpolation():
+    buckets = [(0.1, 10.0), (0.5, 20.0), (math.inf, 20.0)]
+    assert expfmt.bucket_quantile(buckets, 0.5) == pytest.approx(0.1)
+    assert expfmt.bucket_quantile(buckets, 0.75) == pytest.approx(0.3)
+    # rank in the +Inf bucket answers with the highest finite bound
+    assert expfmt.bucket_quantile([(1.0, 0.0), (math.inf, 5.0)], 0.5) == 1.0
+    # empty / all-zero histograms have no quantiles
+    assert expfmt.bucket_quantile([], 0.9) is None
+    assert expfmt.bucket_quantile([(1.0, 0.0), (math.inf, 0.0)], 0.9) is None

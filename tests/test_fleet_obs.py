@@ -1,0 +1,330 @@
+"""Fleet observability: scraping live workers into one merged snapshot
+(obs/aggregate.py), SLO burn-rate alerting over it (obs/slo.py), and the
+``tpu-kubernetes monitor`` CLI (obs/monitor.py).
+
+The "workers" here are real HTTP servers (stdlib ThreadingHTTPServer)
+exposing a per-test Registry at /metrics — live sockets and real scrape
+failures, without paying a model bring-up per test."""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from tpu_kubernetes.obs import expfmt
+from tpu_kubernetes.obs.aggregate import (
+    FleetAggregator,
+    _normalize_target,
+    rate,
+)
+from tpu_kubernetes.obs.metrics import Registry
+from tpu_kubernetes.obs.monitor import fleet_rows, render_table, snapshot_json
+from tpu_kubernetes.obs.slo import (
+    Alert,
+    SLOTracker,
+    availability_source,
+    default_slos,
+    threshold_source,
+)
+
+
+class _Exporter:
+    """A live /metrics endpoint over one Registry."""
+
+    def __init__(self, registry: Registry):
+        self.registry = registry
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):  # noqa: ARG002 — quiet tests
+                pass
+
+            def do_GET(self):  # noqa: N802
+                if self.path != "/metrics":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = outer.registry.render().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), Handler
+        )
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    @property
+    def target(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"{host}:{port}"
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _serving_registry(ok=10, errors_5xx=0, tokens=100,
+                      latencies=(0.05,), inflight=0) -> Registry:
+    """A registry shaped like one serve worker's."""
+    reg = Registry()
+    req = reg.counter("tpu_serve_requests_total", "requests",
+                      labelnames=("endpoint", "code"))
+    if ok:
+        req.labels("/v1/completions", "200").inc(ok)
+    if errors_5xx:
+        req.labels("/v1/completions", "500").inc(errors_5xx)
+    lat = reg.histogram("tpu_serve_request_seconds", "latency",
+                        labelnames=("endpoint",),
+                        buckets=(0.1, 0.5, 1.0))
+    for v in latencies:
+        lat.labels("/v1/completions").observe(v)
+    reg.counter("tpu_serve_tokens_generated_total", "tokens").inc(tokens)
+    reg.gauge("tpu_serve_inflight_requests", "inflight").set(inflight)
+    return reg
+
+
+@pytest.fixture()
+def two_workers():
+    a = _Exporter(_serving_registry(ok=10, tokens=100, inflight=2))
+    b = _Exporter(_serving_registry(ok=30, tokens=900, inflight=0))
+    yield a, b
+    a.stop()
+    b.stop()
+
+
+# -- target normalization ----------------------------------------------------
+
+
+def test_normalize_target_forms():
+    assert _normalize_target("127.0.0.1:9100") == (
+        "127.0.0.1:9100", "http://127.0.0.1:9100/metrics"
+    )
+    assert _normalize_target("http://h:1/metrics") == (
+        "h:1", "http://h:1/metrics"
+    )
+    assert _normalize_target("http://h:1") == ("h:1", "http://h:1/metrics")
+
+
+def test_rate_handles_resets_and_degenerate_windows():
+    assert rate(110.0, 100.0, 5.0) == pytest.approx(2.0)
+    assert rate(5.0, 100.0, 5.0) is None      # counter reset
+    assert rate(1.0, 0.0, 0.0) is None
+
+
+# -- the aggregator against live workers -------------------------------------
+
+
+def test_aggregator_merges_instances(two_workers):
+    a, b = two_workers
+    agg = FleetAggregator([a.target, b.target])
+    snap = agg.scrape_once()
+
+    assert snap.instances() == sorted([a.target, b.target])
+    assert all(h.up == 1 for h in snap.health.values())
+    assert all(h.consecutive_failures == 0 for h in snap.health.values())
+
+    # every merged sample carries its worker's instance label
+    tokens = snap.families["tpu_serve_tokens_generated_total"]
+    assert {s.labels_dict()["instance"] for s in tokens.samples} == {
+        a.target, b.target
+    }
+    assert snap.value_sum("tpu_serve_tokens_generated_total") == 1000
+    mine = lambda inst: lambda labels: labels.get("instance") == inst
+    assert snap.value_sum(
+        "tpu_serve_tokens_generated_total", mine(a.target)
+    ) == 100
+
+    # the synthetic health families use the Prometheus convention
+    up = {s.labels_dict()["instance"]: s.value
+          for s in snap.families["up"].samples}
+    assert up == {a.target: 1.0, b.target: 1.0}
+
+    # the merged view re-exposes losslessly (scrape-able aggregator)
+    reparsed = {f.name for f in expfmt.parse(snap.render())}
+    assert "up" in reparsed and "tpu_serve_requests_total" in reparsed
+
+
+def test_dead_target_degrades_not_fails(two_workers):
+    a, b = two_workers
+    dead_port_target = b.target
+    b.stop()                       # the port is now closed
+    agg = FleetAggregator([a.target, dead_port_target], timeout_s=1.0)
+
+    snap = agg.scrape_once()
+    assert snap.health[a.target].up == 1
+    dead = snap.health[dead_port_target]
+    assert dead.up == 0
+    assert dead.consecutive_failures == 1
+    assert dead.last_error
+
+    snap = agg.scrape_once()       # failures accumulate across cycles
+    assert snap.health[dead_port_target].consecutive_failures == 2
+    # the live worker's samples still merged both times
+    assert snap.value_sum("tpu_serve_tokens_generated_total") == 100
+
+
+def test_histogram_queries_across_fleet(two_workers):
+    a, b = two_workers
+    # a: one fast request; b: one fast + two slow
+    b.registry.histogram(
+        "tpu_serve_request_seconds", "latency", labelnames=("endpoint",),
+        buckets=(0.1, 0.5, 1.0),
+    ).labels("/v1/completions").observe(0.4)
+    snap = FleetAggregator([a.target, b.target]).scrape_once()
+    assert snap.histogram_count("tpu_serve_request_seconds") == 3
+    buckets = dict(snap.histogram_buckets("tpu_serve_request_seconds"))
+    assert buckets[0.1] == 2 and buckets[0.5] == 3
+    assert snap.quantile("tpu_serve_request_seconds", 0.5) is not None
+
+
+def test_fleet_rows_rates_between_cycles(two_workers):
+    a, b = two_workers
+    agg = FleetAggregator([a.target, b.target])
+    first = agg.scrape_once(now=1000.0)
+    rows = {r["instance"]: r for r in fleet_rows(first)}
+    assert rows[a.target]["rps"] is None       # no previous cycle yet
+    assert rows[a.target]["queue_depth"] == 2
+    assert rows[b.target]["requests_total"] == 30
+
+    a.registry.counter(
+        "tpu_serve_requests_total", "requests",
+        labelnames=("endpoint", "code"),
+    ).labels("/v1/completions", "200").inc(50)
+    a.registry.counter(
+        "tpu_serve_tokens_generated_total", "tokens"
+    ).inc(500)
+    second = agg.scrape_once(now=1010.0)
+    rows = {r["instance"]: r for r in fleet_rows(second, prev=first)}
+    assert rows[a.target]["rps"] == pytest.approx(5.0)
+    assert rows[a.target]["tokens_per_s"] == pytest.approx(50.0)
+    assert rows[b.target]["rps"] == pytest.approx(0.0)
+
+
+# -- SLO burn-rate alerting --------------------------------------------------
+
+
+def test_availability_burn_alert_lifecycle(two_workers):
+    """Synthetic 5xx injection drives the availability SLO through the
+    full multi-window life: ok → pending (fast burn) → firing (held past
+    for_s) → fast windows clear while slow still remembers → resolved."""
+    a, b = two_workers
+    req = a.registry.counter(
+        "tpu_serve_requests_total", "requests",
+        labelnames=("endpoint", "code"),
+    )
+    agg = FleetAggregator([a.target, b.target])
+    slo = SLOTracker("availability", 0.999, availability_source,
+                     for_s=60.0)
+    t0 = 1_000_000.0
+
+    def cycle(now):
+        snap = agg.scrape_once(now=now)
+        slo.observe(snap, now=now)
+        return slo.evaluate(now=now)
+
+    req.labels("/v1/completions", "200").inc(1000)
+    alert = cycle(t0)
+    assert alert.state == "ok" and alert.severity == ""
+
+    req.labels("/v1/completions", "500").inc(100)   # inject 5xx burst
+    alert = cycle(t0 + 60)
+    assert alert.state == "pending"
+    assert alert.severity == "page" and alert.since == t0 + 60
+    assert alert.burn_fast >= 14.4
+
+    req.labels("/v1/completions", "200").inc(100)   # bleeding stopped
+    alert = cycle(t0 + 120)
+    assert alert.state == "firing"                  # breach held for_s
+
+    req.labels("/v1/completions", "200").inc(100)
+    alert = cycle(t0 + 420)
+    # the 5m window is past the burst so the fast pair cleared, but the
+    # slow pair still remembers — this is the ticket, not the page
+    assert alert.burn_fast < 14.4
+    assert alert.state == "firing" and alert.severity == "ticket"
+
+    req.labels("/v1/completions", "200").inc(100)
+    alert = cycle(t0 + 2220)
+    assert alert.state == "ok" and alert.since is None  # fully resolved
+
+
+def test_threshold_source_reads_cumulative_buckets(two_workers):
+    a, b = two_workers
+    # a has one 0.05s request; b one 0.05s; add two slow ones to b
+    h = b.registry.histogram(
+        "tpu_serve_request_seconds", "latency", labelnames=("endpoint",),
+        buckets=(0.1, 0.5, 1.0),
+    )
+    h.labels("/v1/completions").observe(0.9)
+    h.labels("/v1/completions").observe(5.0)
+    snap = FleetAggregator([a.target, b.target]).scrape_once()
+    good, total = threshold_source("tpu_serve_request_seconds", 0.5)(snap)
+    assert total == 4 and good == 2           # the two 0.05s requests
+
+
+def test_default_slos_cover_the_serving_objectives():
+    names = {t.name for t in default_slos()}
+    assert names == {"availability", "latency", "ttft"}
+    with pytest.raises(ValueError):
+        SLOTracker("bad", 1.5, availability_source)
+
+
+# -- the monitor CLI ---------------------------------------------------------
+
+
+def test_monitor_once_json_two_live_servers(two_workers, capsys):
+    """Acceptance: `monitor --once --json` against two live workers
+    returns ONE merged snapshot naming both instance labels with up=1;
+    killing one flips its up to 0 without failing the scrape cycle."""
+    from tpu_kubernetes.cli.main import main
+
+    a, b = two_workers
+    argv = ["monitor", "--targets", f"{a.target},{b.target}",
+            "--once", "--json"]
+    assert main(argv) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert set(snap["instances"]) == {a.target, b.target}
+    assert snap["instances"][a.target]["up"] == 1
+    assert snap["instances"][b.target]["up"] == 1
+    assert {al["slo"] for al in snap["alerts"]} == {
+        "availability", "latency", "ttft"
+    }
+
+    b.stop()                                   # one worker dies
+    assert main(argv) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["instances"][a.target]["up"] == 1
+    assert snap["instances"][b.target]["up"] == 0
+    assert snap["instances"][a.target]["requests_total"] == 10
+
+
+def test_monitor_rejects_empty_targets(capsys):
+    from tpu_kubernetes.cli.main import main
+
+    assert main(["monitor", "--targets", " , "]) == 2
+    assert "at least one" in capsys.readouterr().err
+
+
+def test_render_table_rows_and_alerts(two_workers):
+    a, b = two_workers
+    snap = FleetAggregator([a.target, b.target]).scrape_once()
+    rows = fleet_rows(snap)
+    firing = Alert(slo="availability", state="firing", target=0.999,
+                   severity="page", burn_fast=500.0, burn_slow=300.0,
+                   description="non-5xx / all")
+    text = render_table(rows, [firing], ts=snap.ts)
+    assert a.target in text and b.target in text
+    assert "ALERTS" in text and "FIRING" in text and "availability" in text
+    # an ok alert renders nothing
+    calm = render_table(rows, [Alert(slo="x", state="ok", target=0.9)])
+    assert "ALERTS" not in calm
+
+    payload = snapshot_json(snap, rows, [firing])
+    assert payload["alerts"][0]["state"] == "firing"
+    json.dumps(payload)                        # JSON-serializable whole

@@ -312,3 +312,128 @@ def test_run_report_carries_tf_metrics(tmp_path):
         s for s in fam["samples"] if s["labels"] == {"command": "apply"}
     )
     assert sample["count"] >= 1
+
+
+def test_tracer_report_with_pre_eviction_mark():
+    """A mark taken BEFORE spans that later fall out of the ring must not
+    resurrect or double-count anything: report(since=old_mark) returns
+    exactly what the ring still holds."""
+    tr = Tracer(stream=io.StringIO(), max_spans=4)
+    mark = tr.mark()                 # position 0, before any eviction
+    for i in range(7):               # three spans evicted by the end
+        with tr.phase(f"p{i}"):
+            pass
+    assert [p["phase"] for p in tr.report(since=mark)] == [
+        "p3", "p4", "p5", "p6",
+    ]
+    # a mark inside the evicted region behaves identically
+    assert [p["phase"] for p in tr.report(since=2)] == [
+        "p3", "p4", "p5", "p6",
+    ]
+
+
+def test_span_tree_nests_by_run():
+    from tpu_kubernetes.util.trace import span_tree
+
+    tr = Tracer(stream=io.StringIO())
+    with events.run_context("run-a"):
+        with tr.phase("request", endpoint="/x"):
+            with tr.phase("queue"):
+                pass
+            with tr.phase("batch"):
+                pass
+    with events.run_context("run-b"):
+        with tr.phase("other"):
+            pass
+    tree = span_tree(tr.spans, "run-a")
+    assert len(tree) == 1 and tree[0]["name"] == "request"
+    assert [c["name"] for c in tree[0]["children"]] == ["queue", "batch"]
+    assert span_tree(tr.spans, "run-b")[0]["name"] == "other"
+    assert span_tree(tr.spans, "run-missing") == []
+
+
+# -- event sink size rotation -----------------------------------------------
+
+
+def test_event_sink_rotates_by_size(tmp_path):
+    path = tmp_path / "events.jsonl"
+    sink = events.EventSink(path=str(path), max_bytes=200)
+    for i in range(20):
+        sink.write({"kind": "tick", "i": i})
+    rotated = tmp_path / "events.jsonl.1"
+    assert rotated.exists()
+    # both generations hold whole lines — rotation lands on boundaries
+    for p in (path, rotated):
+        lines = p.read_text().splitlines()
+        assert lines and all(json.loads(ln)["kind"] == "tick" for ln in lines)
+    assert rotated.stat().st_size <= 200
+    # the two generations partition the history, newest in the live file
+    live = [json.loads(ln)["i"] for ln in path.read_text().splitlines()]
+    assert live[-1] == 19
+
+
+def test_event_sink_rotation_disabled_and_env(tmp_path, monkeypatch):
+    path = tmp_path / "events.jsonl"
+    sink = events.EventSink(path=str(path), max_bytes=0)   # ≤0 disables
+    for i in range(50):
+        sink.write({"kind": "tick", "i": i})
+    assert not (tmp_path / "events.jsonl.1").exists()
+    assert len(path.read_text().splitlines()) == 50
+
+    monkeypatch.setenv("TPU_K8S_EVENTS_MAX_MB", "2")
+    assert events.EventSink(path="x")._max_bytes == 2 * 1024 * 1024
+    monkeypatch.setenv("TPU_K8S_EVENTS_MAX_MB", "junk")    # bad → default
+    assert events.EventSink(path="x")._max_bytes == int(
+        events.DEFAULT_MAX_MB * 1024 * 1024
+    )
+    monkeypatch.delenv("TPU_K8S_EVENTS_MAX_MB")
+    assert events.EventSink(path="x")._max_bytes == int(
+        events.DEFAULT_MAX_MB * 1024 * 1024
+    )
+
+
+def test_event_sink_rotation_failure_swallowed(tmp_path, monkeypatch):
+    path = tmp_path / "events.jsonl"
+    sink = events.EventSink(path=str(path), max_bytes=50)
+
+    def refuse(*_):
+        raise OSError("rename refused")
+
+    monkeypatch.setattr("os.replace", refuse)
+    for i in range(10):
+        sink.write({"kind": "tick", "i": i})    # must not raise
+    assert len(path.read_text().splitlines()) == 10
+
+
+# -- runs/ retention --------------------------------------------------------
+
+
+def test_runs_keep_env_override(monkeypatch):
+    from tpu_kubernetes.util.runlog import DEFAULT_RUNS_KEEP, runs_keep
+
+    monkeypatch.delenv("TPU_K8S_RUNS_KEEP", raising=False)
+    assert runs_keep() == DEFAULT_RUNS_KEEP
+    assert runs_keep(default=5) == 5          # backend-configured cap
+    monkeypatch.setenv("TPU_K8S_RUNS_KEEP", "7")
+    assert runs_keep() == 7
+    assert runs_keep(default=5) == 7          # env wins over the backend
+    monkeypatch.setenv("TPU_K8S_RUNS_KEEP", "0")
+    assert runs_keep() == 1                   # latest run must survive
+    monkeypatch.setenv("TPU_K8S_RUNS_KEEP", "junk")
+    assert runs_keep(default=5) == 5          # bad override falls through
+
+
+def test_run_reports_pruned_to_retention_cap(tmp_path, monkeypatch):
+    from tpu_kubernetes.backend import LocalBackend
+    from tpu_kubernetes.util.runlog import run_recorder
+
+    monkeypatch.setenv("TPU_K8S_RUNS_KEEP", "3")
+    backend = LocalBackend(tmp_path / "backend")
+    for i in range(6):
+        with run_recorder(backend, "dev", f"create manager {i}"):
+            pass
+    reports = backend.run_reports("dev")
+    assert len(reports) == 3                  # oldest pruned on write
+    assert [r["command"] for r in reports] == [
+        "create manager 3", "create manager 4", "create manager 5",
+    ]

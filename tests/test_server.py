@@ -700,3 +700,144 @@ class TestShardedServer:
             make_server(dict(ENV, SERVE_MESH="data=2"))
         with pytest.raises(ValueError, match="devices"):
             make_server(dict(ENV, SERVE_MESH="tensor=64"))
+
+
+# -- request-id propagation & GET /debug/trace -------------------------------
+
+def _raw(server, method, path, body=None, headers=None):
+    """Like _request but also returns the X-Request-Id response header."""
+    host, port = server.server_address[:2]
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request(
+        method, path,
+        body=None if body is None else json.dumps(body),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    resp = conn.getresponse()
+    data = resp.read()
+    rid = resp.getheader("X-Request-Id")
+    status = resp.status
+    conn.close()
+    return status, data, rid
+
+
+def test_request_id_on_every_response(server):
+    """Success, 404, and 400 responses all carry a minted X-Request-Id."""
+    status, _, rid = _raw(server, "GET", "/healthz")
+    assert status == 200 and rid
+    status, _, rid404 = _raw(server, "GET", "/nope")
+    assert status == 404 and rid404
+    assert rid404 != rid                     # minted per request
+    status, _, rid400 = _raw(server, "POST", "/v1/completions", {"nope": 1})
+    assert status == 400 and rid400
+
+
+def test_inbound_request_id_echoed_and_traced(server):
+    """A caller-chosen X-Request-Id is echoed back and keys the span
+    tree: queue/batch/decode phases nested under one request root."""
+    rid = "trace-me-completion-0001"
+    status, _, got = _raw(
+        server, "POST", "/v1/completions",
+        {"prompt": "trace", "max_new_tokens": 4},
+        headers={"X-Request-Id": rid},
+    )
+    assert status == 200 and got == rid
+
+    status, data, _ = _raw(server, "GET", f"/debug/trace/{rid}")
+    assert status == 200
+    tree = json.loads(data)
+    assert tree["run"] == rid
+    roots = tree["spans"]
+    assert len(roots) == 1
+    root = roots[0]
+    assert root["name"] == "request"
+    assert root["meta"]["endpoint"] == "/v1/completions"
+    children = {c["name"] for c in root["children"]}
+    assert {"queue", "batch", "decode"} <= children
+    batch = next(c for c in root["children"] if c["name"] == "batch")
+    assert batch["meta"]["mode"] == "solo"   # module server has no batcher
+
+
+def test_streaming_response_carries_request_id_and_trace(server):
+    """SSE responses get the header too, and the streamed run's trace
+    includes the prefill and decode phases (decode runs on the producer
+    thread — the request context must follow it there)."""
+    rid = "trace-me-stream-0001"
+    host, port = server.server_address[:2]
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request(
+        "POST", "/v1/completions",
+        body=json.dumps(
+            {"prompt": "stream trace", "max_new_tokens": 4, "stream": True}
+        ),
+        headers={"Content-Type": "application/json", "X-Request-Id": rid},
+    )
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("X-Request-Id") == rid
+    text, done, _, _ = _read_sse(resp)
+    conn.close()
+    assert done and text
+
+    status, data, _ = _raw(server, "GET", f"/debug/trace/{rid}")
+    assert status == 200
+    names = set()
+
+    def walk(nodes):
+        for n in nodes:
+            names.add(n["name"])
+            walk(n["children"])
+
+    walk(json.loads(data)["spans"])
+    assert {"request", "queue", "prefill", "decode"} <= names
+
+
+def test_debug_trace_unknown_id_is_404(server):
+    status, data, rid = _raw(server, "GET", "/debug/trace/no-such-run")
+    assert status == 404 and rid             # errors are traced too
+    payload = json.loads(data)
+    assert "hint" in payload
+
+
+def test_inflight_gauge_exported(server):
+    """The queue-depth gauge a fleet monitor reads: the scrape itself is
+    in flight while the registry renders, so the sample is ≥ 1."""
+    host, port = server.server_address[:2]
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    text = resp.read().decode()
+    conn.close()
+    assert resp.status == 200
+    assert "# TYPE tpu_serve_inflight_requests gauge" in text
+    line = next(
+        ln for ln in text.splitlines()
+        if ln.startswith("tpu_serve_inflight_requests ")
+    )
+    assert float(line.split()[-1]) >= 1
+
+
+def test_batched_trace_has_queue_and_batch_spans():
+    """Under SERVER_BATCH the queue span covers the dispatch wait and the
+    batch span the co-ride — both visible in the request's trace."""
+    srv = make_server(dict(ENV, SERVER_BATCH="4",
+                           SERVER_BATCH_WINDOW_MS="10"))
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        rid = "trace-me-batched-0001"
+        status, _, got = _raw(
+            srv, "POST", "/v1/completions",
+            {"prompt": "batched trace", "max_new_tokens": 4},
+            headers={"X-Request-Id": rid},
+        )
+        assert status == 200 and got == rid
+        status, data, _ = _raw(srv, "GET", f"/debug/trace/{rid}")
+        assert status == 200
+        root = json.loads(data)["spans"][0]
+        assert root["name"] == "request"
+        children = {c["name"]: c for c in root["children"]}
+        assert {"queue", "batch", "decode"} <= set(children)
+        assert children["batch"]["meta"]["mode"] == "batched"
+    finally:
+        srv.shutdown()

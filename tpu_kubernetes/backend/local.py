@@ -124,17 +124,20 @@ class LocalBackend(Backend):
 
     # runs/<ns-timestamp>.json next to main.tf.json (SURVEY §5.1: the reference has
     # no observability at all; the north-star latency must be readable here).
-    # Retention is capped so a long-lived manager doesn't accumulate forever.
-    MAX_RUN_REPORTS = 100
+    # Retention is capped so a long-lived manager doesn't accumulate forever;
+    # TPU_K8S_RUNS_KEEP overrides (util/runlog.py — one policy per backend).
+    MAX_RUN_REPORTS = 50
 
     def persist_run_report(self, name: str, report: dict[str, Any]) -> None:
+        from tpu_kubernetes.util.runlog import runs_keep
+
         d = self._dir(name) / "runs"
         d.mkdir(parents=True, exist_ok=True)
         ts = time.time_ns()
         tmp = d / f"{ts}.json.tmp"
         tmp.write_bytes(json.dumps(report, indent=2, sort_keys=True).encode())
         tmp.replace(d / f"{ts}.json")
-        stale = sorted(d.glob("*.json"))[:-self.MAX_RUN_REPORTS]
+        stale = sorted(d.glob("*.json"))[:-runs_keep(self.MAX_RUN_REPORTS)]
         for p in stale:
             p.unlink(missing_ok=True)
 

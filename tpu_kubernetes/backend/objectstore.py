@@ -224,17 +224,20 @@ class ObjectStoreBackend(Backend):
 
     # {PREFIX}/{manager}/runs/{ns-timestamp}.json (SURVEY §5.1 gap: per-run phase
     # timings persisted next to the document, mirroring LocalBackend).
-    # Retention is capped so a long-lived manager doesn't accumulate forever.
-    MAX_RUN_REPORTS = 100
+    # Retention is capped so a long-lived manager doesn't accumulate forever;
+    # TPU_K8S_RUNS_KEEP overrides (util/runlog.py — one policy per backend).
+    MAX_RUN_REPORTS = 50
 
     def persist_run_report(self, name: str, report: dict[str, Any]) -> None:
+        from tpu_kubernetes.util.runlog import runs_keep
+
         ts = time.time_ns()
         self.store.put(
             self._key(name, f"runs/{ts}.json"),
             json.dumps(report, indent=2, sort_keys=True).encode(),
         )
         keys = sorted(self.store.list(self._key(name, "runs/")))
-        for key in keys[:-self.MAX_RUN_REPORTS]:
+        for key in keys[:-runs_keep(self.MAX_RUN_REPORTS)]:
             self.store.delete(key)
 
     def run_reports(self, name: str) -> list[dict[str, Any]]:

@@ -112,6 +112,30 @@ def build_parser() -> argparse.ArgumentParser:
              "blip)",
     )
 
+    monitor = sub.add_parser(
+        "monitor",
+        help="watch a fleet of serving workers: scrape each /metrics, "
+             "render per-instance RPS / latency / TTFT / tokens-per-sec "
+             "/ queue depth, and evaluate SLO burn-rate alerts",
+    )
+    monitor.add_argument(
+        "--targets", metavar="HOST:PORT[,HOST:PORT...]",
+        default="127.0.0.1:8000",
+        help="comma-separated worker endpoints (default 127.0.0.1:8000)",
+    )
+    monitor.add_argument(
+        "--interval", type=float, default=5.0, metavar="SECONDS",
+        help="seconds between scrape cycles (default 5)",
+    )
+    monitor.add_argument(
+        "--json", dest="as_json", action="store_true",
+        help="emit one JSON snapshot per cycle instead of the table",
+    )
+    monitor.add_argument(
+        "--once", action="store_true",
+        help="one scrape cycle, then exit (scripting/smoke checks)",
+    )
+
     sub.add_parser("version", help="print the version")
     return parser
 
@@ -124,6 +148,21 @@ def main(argv: list[str] | None = None) -> int:
         # reference: cmd/version.go:13-26
         print(f"tpu-kubernetes v{tpu_kubernetes.__version__}")
         return 0
+
+    if args.command == "monitor":
+        # fleet observation needs no backend, config, or prompts — just
+        # the worker endpoints to scrape (obs/monitor.py)
+        from tpu_kubernetes.obs.monitor import run_monitor
+
+        targets = [t.strip() for t in args.targets.split(",") if t.strip()]
+        if not targets:
+            print("error: monitor needs at least one --targets endpoint",
+                  file=sys.stderr)
+            return 2
+        return run_monitor(
+            targets, interval=args.interval, once=args.once,
+            as_json=args.as_json,
+        )
 
     if args.command == "get" and args.kind == "metrics":
         # this process's registry (terraform command families registered by

@@ -123,6 +123,15 @@ def format_runs(reports: list[dict[str, Any]], history: int = 10) -> str:
     hidden = len(reports) - min(len(reports), history)
     if hidden:
         lines.append(f"… and {hidden} older run(s) — use --json for all")
+    from tpu_kubernetes.util.runlog import runs_keep
+
+    if len(reports) >= runs_keep():
+        # the backends prune on write, newest kept — say so rather than
+        # letting a full window read as "history begins here"
+        lines.append(
+            f"(retention cap reached: the backend keeps the newest "
+            f"{runs_keep()} runs — TPU_K8S_RUNS_KEEP overrides)"
+        )
     last = newest_first[0]
     lines.append("")
     lines.append(

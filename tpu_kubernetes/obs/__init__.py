@@ -10,6 +10,19 @@ neither):
 * :mod:`tpu_kubernetes.obs.events` — JSONL structured events with
   run/correlation ids and nested parent spans (``TPU_K8S_EVENTS=<path>``
   to enable), which util/trace.py phases feed.
+
+Fleet-scope layers on top (imported lazily — none are needed for the
+single-process path):
+
+* :mod:`tpu_kubernetes.obs.expfmt` — parser/renderer for the text
+  exposition REGISTRY emits, round-trip safe.
+* :mod:`tpu_kubernetes.obs.aggregate` — concurrent multi-target
+  ``/metrics`` scraper merging workers into one fleet snapshot with
+  ``instance`` labels and per-target ``up`` health.
+* :mod:`tpu_kubernetes.obs.slo` — sliding-window SLOs with
+  multi-window burn-rate alerting over fleet snapshots.
+* :mod:`tpu_kubernetes.obs.monitor` — the ``tpu-kubernetes monitor``
+  fleet table / JSON renderer.
 """
 
 from tpu_kubernetes.obs.metrics import (  # noqa: F401

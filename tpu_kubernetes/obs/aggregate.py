@@ -1,0 +1,249 @@
+"""Fleet-scope metrics aggregation: scrape N workers, merge one view.
+
+PR 1 gave every process its own registry and ``GET /metrics``; this is
+the layer that can see the fleet (ROADMAP north star: many serve/train
+workers behind one operator). A :class:`FleetAggregator` polls each
+target's ``/metrics`` concurrently (bounded by per-request timeouts and
+a retry), parses the exposition (obs/expfmt.py), tags every sample with
+an ``instance`` label, and merges the lot into one
+:class:`FleetSnapshot` — which renders back out as exposition (the
+aggregator is itself scrape-able) and answers the queries the SLO
+evaluator (obs/slo.py) and the ``monitor`` CLI ask.
+
+Per-target scrape health is first-class: ``up`` (the Prometheus
+convention — 1 scraped, 0 failed), scrape latency, and consecutive
+failure counts survive across cycles, so one dead worker reads as
+``up=0`` without failing the cycle for its siblings.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field, replace
+from typing import Callable
+
+from tpu_kubernetes.obs import expfmt
+
+# synthetic per-target families the aggregator itself contributes
+UP = "up"
+SCRAPE_SECONDS = "fleet_scrape_duration_seconds"
+SCRAPE_FAILURES = "fleet_scrape_consecutive_failures"
+
+
+@dataclass
+class TargetHealth:
+    instance: str
+    up: int = 0
+    consecutive_failures: int = 0
+    last_scrape_seconds: float = 0.0
+    last_error: str = ""
+    last_success_ts: float = 0.0
+
+
+@dataclass
+class FleetSnapshot:
+    """One merged scrape cycle: every worker's families with ``instance``
+    labels, plus the synthetic health families."""
+
+    ts: float
+    health: dict[str, TargetHealth]
+    families: dict[str, expfmt.Family]
+
+    def instances(self) -> list[str]:
+        return sorted(self.health)
+
+    def render(self) -> str:
+        """The merged view as text exposition (name-ordered, like the
+        per-process registry) — the aggregator re-exposes losslessly."""
+        return expfmt.render(
+            [self.families[n] for n in sorted(self.families)]
+        )
+
+    # -- queries (what obs/slo.py and the monitor table read) --------------
+
+    def _samples(self, sample_name: str, family: str,
+                 where: Callable[[dict[str, str]], bool] | None):
+        fam = self.families.get(family)
+        if fam is None:
+            return
+        for s in fam.samples:
+            if s.name != sample_name:
+                continue
+            if where is None or where(s.labels_dict()):
+                yield s
+
+    def value_sum(self, name: str,
+                  where: Callable[[dict[str, str]], bool] | None = None,
+                  ) -> float:
+        """Sum a counter/gauge family's samples across the fleet
+        (optionally filtered by a labels predicate, e.g. one instance)."""
+        return sum(s.value for s in self._samples(name, name, where))
+
+    def histogram_buckets(self, name: str,
+                          where: Callable[[dict[str, str]], bool] | None = None,
+                          ) -> list[tuple[float, float]]:
+        """Cumulative ``(le, count)`` pairs for a histogram family,
+        bucket-wise summed across matching series (le grids are shared —
+        every worker runs the same instrumentation)."""
+        acc: dict[float, float] = {}
+        for s in self._samples(f"{name}_bucket", name, where):
+            le = expfmt.parse_value(s.labels_dict().get("le", "+Inf"))
+            acc[le] = acc.get(le, 0.0) + s.value
+        return sorted(acc.items())
+
+    def histogram_count(self, name: str,
+                        where: Callable[[dict[str, str]], bool] | None = None,
+                        ) -> float:
+        return sum(s.value for s in self._samples(f"{name}_count", name, where))
+
+    def histogram_sum(self, name: str,
+                      where: Callable[[dict[str, str]], bool] | None = None,
+                      ) -> float:
+        return sum(s.value for s in self._samples(f"{name}_sum", name, where))
+
+    def quantile(self, name: str, q: float,
+                 where: Callable[[dict[str, str]], bool] | None = None,
+                 ) -> float | None:
+        return expfmt.bucket_quantile(self.histogram_buckets(name, where), q)
+
+
+@dataclass
+class ScrapeResult:
+    instance: str
+    ok: bool
+    seconds: float
+    families: list[expfmt.Family] = field(default_factory=list)
+    error: str = ""
+
+
+def _normalize_target(target: str) -> tuple[str, str]:
+    """``host:port`` (or a full URL) → (instance label, scrape URL)."""
+    target = target.strip()
+    if "://" not in target:
+        return target, f"http://{target}/metrics"
+    rest = target.split("://", 1)[1]
+    instance = rest.split("/", 1)[0]
+    if rest == instance:  # bare scheme://host:port — default the path
+        return instance, f"{target.rstrip('/')}/metrics"
+    return instance, target
+
+
+class FleetAggregator:
+    """Thread-safe multi-target scraper. ``scrape_once`` may be called
+    from any thread (the monitor loop, a test, a future autoscaler);
+    health state is cumulative across cycles under one lock."""
+
+    def __init__(self, targets: list[str], timeout_s: float = 2.0,
+                 retries: int = 1, max_workers: int = 16):
+        self._targets = [_normalize_target(t) for t in targets]
+        if not self._targets:
+            raise ValueError("FleetAggregator needs at least one target")
+        self.timeout_s = timeout_s
+        self.retries = max(0, int(retries))
+        self._max_workers = max(1, min(max_workers, len(self._targets)))
+        self._lock = threading.Lock()
+        self._health: dict[str, TargetHealth] = {
+            instance: TargetHealth(instance=instance)
+            for instance, _ in self._targets
+        }
+
+    def _fetch(self, url: str) -> str:
+        req = urllib.request.Request(
+            url, headers={"Accept": "text/plain", "User-Agent": "tpu-k8s-monitor"}
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return resp.read().decode("utf-8", "replace")
+
+    def _scrape_target(self, instance: str, url: str) -> ScrapeResult:
+        last_error = ""
+        t0 = time.monotonic()
+        for _ in range(self.retries + 1):
+            try:
+                families = expfmt.parse(self._fetch(url))
+            except Exception as e:  # noqa: BLE001 — per-target isolation
+                last_error = f"{type(e).__name__}: {e}"[:200]
+                continue
+            return ScrapeResult(
+                instance=instance, ok=True,
+                seconds=time.monotonic() - t0, families=families,
+            )
+        return ScrapeResult(
+            instance=instance, ok=False,
+            seconds=time.monotonic() - t0, error=last_error,
+        )
+
+    def health(self) -> dict[str, TargetHealth]:
+        with self._lock:
+            return {i: replace(h) for i, h in self._health.items()}
+
+    def scrape_once(self, now: float | None = None) -> FleetSnapshot:
+        """One fleet cycle: scrape every target concurrently, update
+        health, and return the merged snapshot. A failing target never
+        fails the cycle — it contributes ``up=0`` and keeps its last
+        error on record."""
+        now = time.time() if now is None else now
+        with ThreadPoolExecutor(max_workers=self._max_workers) as pool:
+            results = list(pool.map(
+                lambda t: self._scrape_target(*t), self._targets
+            ))
+
+        with self._lock:
+            for r in results:
+                h = self._health[r.instance]
+                h.up = 1 if r.ok else 0
+                h.last_scrape_seconds = round(r.seconds, 6)
+                if r.ok:
+                    h.consecutive_failures = 0
+                    h.last_error = ""
+                    h.last_success_ts = now
+                else:
+                    h.consecutive_failures += 1
+                    h.last_error = r.error
+            health = {i: replace(h) for i, h in self._health.items()}
+
+        merged: dict[str, expfmt.Family] = {}
+        for r in results:
+            for fam in r.families:
+                dst = merged.get(fam.name)
+                if dst is None:
+                    dst = merged[fam.name] = expfmt.Family(
+                        name=fam.name, help=fam.help, kind=fam.kind
+                    )
+                dst.samples.extend(
+                    s.with_label("instance", r.instance) for s in fam.samples
+                )
+
+        for name, help_, kind, value_of in (
+            (UP, "1 if the target's last scrape succeeded", "gauge",
+             lambda h: float(h.up)),
+            (SCRAPE_SECONDS, "wall time of the target's last scrape",
+             "gauge", lambda h: h.last_scrape_seconds),
+            (SCRAPE_FAILURES, "scrape failures since the last success",
+             "gauge", lambda h: float(h.consecutive_failures)),
+        ):
+            merged[name] = expfmt.Family(
+                name=name, help=help_, kind=kind,
+                samples=[
+                    expfmt.Sample(
+                        name=name, labels=(("instance", i),),
+                        value=value_of(health[i]),
+                    )
+                    for i in sorted(health)
+                ],
+            )
+        return FleetSnapshot(ts=now, health=health, families=merged)
+
+
+def rate(now_value: float, then_value: float, seconds: float) -> float | None:
+    """Per-second rate between two cumulative readings; None when the
+    elapsed window is degenerate or a counter reset went backwards."""
+    if seconds <= 0 or not math.isfinite(seconds):
+        return None
+    delta = now_value - then_value
+    if delta < 0:  # worker restarted between cycles
+        return None
+    return delta / seconds

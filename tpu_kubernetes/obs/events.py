@@ -50,13 +50,44 @@ def current_span_id() -> str | None:
     return _parent_span.get()
 
 
-class EventSink:
-    """Thread-safe JSONL writer over a path or an open stream."""
+DEFAULT_MAX_MB = 64.0
 
-    def __init__(self, path: str | None = None, stream: io.IOBase | None = None):
+
+def _max_bytes_from_env() -> int:
+    try:
+        mb = float(os.environ.get("TPU_K8S_EVENTS_MAX_MB", "") or DEFAULT_MAX_MB)
+    except ValueError:
+        mb = DEFAULT_MAX_MB
+    return int(mb * 1024 * 1024)
+
+
+class EventSink:
+    """Thread-safe JSONL writer over a path or an open stream.
+
+    Path sinks rotate by size so a long-lived server cannot fill a disk:
+    when the file would exceed ``max_bytes`` (``TPU_K8S_EVENTS_MAX_MB``,
+    default 64; ≤0 disables) it is renamed to ``<path>.1`` — one
+    generation of history, always at a line boundary — and the stream
+    starts fresh. Rotation failures are swallowed like every other sink
+    failure: observability must not fail the workflow."""
+
+    def __init__(self, path: str | None = None, stream: io.IOBase | None = None,
+                 max_bytes: int | None = None):
         self._path = path
         self._stream = stream
+        self._max_bytes = (
+            _max_bytes_from_env() if max_bytes is None else int(max_bytes)
+        )
         self._lock = threading.Lock()
+
+    def _maybe_rotate(self, incoming: int) -> None:
+        if self._max_bytes <= 0 or self._path is None:
+            return
+        try:
+            if os.path.getsize(self._path) + incoming > self._max_bytes:
+                os.replace(self._path, f"{self._path}.1")
+        except OSError:
+            pass  # no file yet, or rename refused — keep appending
 
     def write(self, event: dict) -> None:
         line = json.dumps(event, sort_keys=True, default=str)
@@ -65,6 +96,7 @@ class EventSink:
                 self._stream.write(line + "\n")
                 self._stream.flush()
             elif self._path is not None:
+                self._maybe_rotate(len(line) + 1)
                 with open(self._path, "a", encoding="utf-8") as f:
                     f.write(line + "\n")
 

@@ -1,0 +1,167 @@
+"""The ``tpu-kubernetes monitor`` loop: fleet table + firing SLO alerts.
+
+Ties the fleet layer together for an operator terminal: poll the
+aggregator (obs/aggregate.py), feed the SLO trackers (obs/slo.py), and
+render one line per worker — RPS, latency quantiles, TTFT, tokens/sec,
+in-flight queue depth, and ``up`` — plus whatever alerts are pending or
+firing. ``--json`` emits the same snapshot as one JSON object per cycle
+(what scripts and the acceptance tests consume); ``--once`` does a
+single cycle and exits.
+
+Rates (RPS, tokens/sec) are deltas between consecutive cycles, so the
+first cycle — and every ``--once`` run — shows ``-`` for them; quantiles
+come from the cumulative histograms (since worker start).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Callable, TextIO
+
+from tpu_kubernetes.obs.aggregate import FleetAggregator, FleetSnapshot, rate
+from tpu_kubernetes.obs.slo import Alert, SLOTracker, default_slos
+
+REQUESTS = "tpu_serve_requests_total"
+LATENCY = "tpu_serve_request_seconds"
+TTFT = "tpu_serve_time_to_first_token_seconds"
+TOKENS = "tpu_serve_tokens_generated_total"
+INFLIGHT = "tpu_serve_inflight_requests"
+
+
+def _of_instance(instance: str) -> Callable[[dict[str, str]], bool]:
+    return lambda labels: labels.get("instance") == instance
+
+
+def fleet_rows(snapshot: FleetSnapshot,
+               prev: FleetSnapshot | None = None) -> list[dict[str, Any]]:
+    """Per-instance stats rows. ``prev`` (the previous cycle's snapshot)
+    enables the rate columns; without it they are None."""
+    rows = []
+    dt = snapshot.ts - prev.ts if prev is not None else 0.0
+    for instance in snapshot.instances():
+        health = snapshot.health[instance]
+        mine = _of_instance(instance)
+        requests = snapshot.value_sum(REQUESTS, mine)
+        tokens = snapshot.value_sum(TOKENS, mine)
+        row: dict[str, Any] = {
+            "instance": instance,
+            "up": health.up,
+            "consecutive_failures": health.consecutive_failures,
+            "scrape_seconds": health.last_scrape_seconds,
+            "error": health.last_error,
+            "requests_total": requests,
+            "tokens_total": tokens,
+            "rps": None,
+            "tokens_per_s": None,
+            "p50_s": snapshot.quantile(LATENCY, 0.50, mine),
+            "p99_s": snapshot.quantile(LATENCY, 0.99, mine),
+            "ttft_p99_s": snapshot.quantile(TTFT, 0.99, mine),
+            "queue_depth": snapshot.value_sum(INFLIGHT, mine),
+        }
+        if prev is not None and instance in prev.health:
+            row["rps"] = rate(
+                requests, prev.value_sum(REQUESTS, mine), dt
+            )
+            row["tokens_per_s"] = rate(
+                tokens, prev.value_sum(TOKENS, mine), dt
+            )
+        rows.append(row)
+    return rows
+
+
+def _fmt(value: Any, unit: str = "", width: int = 8) -> str:
+    if value is None:
+        return "-".rjust(width)
+    if isinstance(value, float):
+        text = f"{value:.3f}{unit}" if abs(value) < 100 else f"{value:.0f}{unit}"
+    else:
+        text = f"{value}{unit}"
+    return text.rjust(width)
+
+
+def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
+                 ts: float | None = None) -> str:
+    """The human rendering: one aligned row per instance, then any
+    pending/firing alerts."""
+    header = (
+        f"{'INSTANCE':<24} {'UP':>2} {'RPS':>8} {'P50':>8} {'P99':>8} "
+        f"{'TTFT99':>8} {'TOK/S':>8} {'QUEUE':>6}"
+    )
+    lines = []
+    if ts is not None:
+        lines.append(time.strftime(
+            "fleet @ %Y-%m-%d %H:%M:%S", time.localtime(ts)
+        ))
+    lines.append(header)
+    for row in rows:
+        lines.append(
+            f"{row['instance']:<24} {row['up']:>2}"
+            f"{_fmt(row['rps'])}"
+            f"{_fmt(row['p50_s'], 's', 9)}"
+            f"{_fmt(row['p99_s'], 's', 9)}"
+            f"{_fmt(row['ttft_p99_s'], 's', 9)}"
+            f"{_fmt(row['tokens_per_s'])}"
+            f"{_fmt(int(row['queue_depth']), '', 7)}"
+        )
+        if not row["up"] and row["error"]:
+            lines.append(
+                f"  └─ down ({row['consecutive_failures']} consecutive): "
+                f"{row['error']}"
+            )
+    active = [a for a in alerts if a.state != "ok"]
+    if active:
+        lines.append("")
+        lines.append("ALERTS")
+        for a in active:
+            lines.append(
+                f"  [{a.state.upper():>7}] {a.slo} (target {a.target:.3%})"
+                f" burn fast={a.burn_fast:.1f}x slow={a.burn_slow:.1f}x"
+                f"{' — ' + a.description if a.description else ''}"
+            )
+    return "\n".join(lines) + "\n"
+
+
+def snapshot_json(snapshot: FleetSnapshot, rows: list[dict[str, Any]],
+                  alerts: list[Alert]) -> dict[str, Any]:
+    """One cycle as a JSON-ready object (``monitor --json``)."""
+    return {
+        "ts": snapshot.ts,
+        "instances": {row["instance"]: row for row in rows},
+        "alerts": [a.to_dict() for a in alerts],
+    }
+
+
+def run_monitor(targets: list[str], interval: float = 5.0,
+                once: bool = False, as_json: bool = False,
+                out: TextIO | None = None,
+                slos: list[SLOTracker] | None = None,
+                max_cycles: int | None = None,
+                timeout_s: float = 2.0) -> int:
+    """The CLI loop. Returns the process exit code."""
+    out = sys.stdout if out is None else out
+    aggregator = FleetAggregator(targets, timeout_s=timeout_s)
+    trackers = default_slos() if slos is None else slos
+    prev: FleetSnapshot | None = None
+    cycles = 0
+    try:
+        while True:
+            snapshot = aggregator.scrape_once()
+            for tracker in trackers:
+                tracker.observe(snapshot, now=snapshot.ts)
+            alerts = [t.evaluate(now=snapshot.ts) for t in trackers]
+            rows = fleet_rows(snapshot, prev)
+            if as_json:
+                print(json.dumps(snapshot_json(snapshot, rows, alerts),
+                                 sort_keys=True), file=out, flush=True)
+            else:
+                print(render_table(rows, alerts, ts=snapshot.ts),
+                      file=out, flush=True)
+            prev = snapshot
+            cycles += 1
+            if once or (max_cycles is not None and cycles >= max_cycles):
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0

@@ -1,0 +1,225 @@
+"""Sliding-window SLOs with multi-window burn-rate alerting.
+
+The fleet aggregator (obs/aggregate.py) answers "what are the numbers";
+this module answers "is the fleet keeping its promises". Each
+:class:`SLOTracker` reduces a :class:`~tpu_kubernetes.obs.aggregate.
+FleetSnapshot` to a good/total event pair (availability from status
+codes, latency and TTFT from histogram buckets vs a threshold), keeps a
+bounded history of readings, and evaluates the multi-window burn-rate
+rule from the SRE workbook:
+
+* **fast** — burn ≥ 14.4× over BOTH the 5m and 1h windows (budget gone
+  in hours → page);
+* **slow** — burn ≥ 6× over BOTH the 30m and 6h windows (budget gone in
+  days → ticket).
+
+Burn rate = (bad events / total events over the window) / (1 − target).
+Requiring both windows makes alerts resolve quickly once the bleeding
+stops (the short window goes clean first) without flapping on blips.
+
+Alerts move ``ok → pending → firing``: a breach must hold for ``for_s``
+seconds before it pages, and any clean evaluation resolves it. Clocks
+are injectable (``now=``) so tests can drive hours of window arithmetic
+in milliseconds; production callers just omit it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable
+
+from tpu_kubernetes.obs.aggregate import FleetSnapshot
+
+# (windows that must BOTH breach, burn multiple, severity)
+FAST_WINDOWS = (300.0, 3600.0)
+FAST_BURN = 14.4
+SLOW_WINDOWS = (1800.0, 21600.0)
+SLOW_BURN = 6.0
+
+OK = "ok"
+PENDING = "pending"
+FIRING = "firing"
+
+
+@dataclass
+class Alert:
+    """One SLO's evaluated state at a point in time."""
+
+    slo: str
+    state: str
+    target: float
+    severity: str = ""            # "page" (fast) / "ticket" (slow) when breaching
+    since: float | None = None    # when the current pending/firing began
+    burn_fast: float = 0.0        # min burn over the fast window pair
+    burn_slow: float = 0.0        # min burn over the slow window pair
+    description: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "slo": self.slo,
+            "state": self.state,
+            "target": self.target,
+            "severity": self.severity,
+            "since": self.since,
+            "burn_fast": round(self.burn_fast, 3),
+            "burn_slow": round(self.burn_slow, 3),
+            "description": self.description,
+        }
+
+
+@dataclass
+class _Reading:
+    ts: float
+    good: float
+    total: float
+
+
+class SLOTracker:
+    """One objective: a good/total reduction over snapshots plus the
+    burn-rate state machine. Thread-safe (the monitor loop observes
+    while a CLI/status thread may evaluate)."""
+
+    def __init__(self, name: str, target: float,
+                 source: Callable[[FleetSnapshot], tuple[float, float]],
+                 for_s: float = 60.0, description: str = ""):
+        if not 0.0 < target < 1.0:
+            raise ValueError(f"SLO target must be in (0, 1), got {target}")
+        self.name = name
+        self.target = target
+        self.for_s = for_s
+        self.description = description
+        self._source = source
+        self._history: deque[_Reading] = deque()
+        self._state = OK
+        self._since: float | None = None
+        self._lock = threading.Lock()
+
+    def observe(self, snapshot: FleetSnapshot,
+                now: float | None = None) -> None:
+        """Record one aggregated cycle's good/total reading."""
+        now = time.time() if now is None else now
+        good, total = self._source(snapshot)
+        keep_after = now - (max(SLOW_WINDOWS) + 600.0)
+        with self._lock:
+            self._history.append(_Reading(now, float(good), float(total)))
+            while self._history and self._history[0].ts < keep_after:
+                self._history.popleft()
+
+    def _burn(self, window: float, now: float) -> float:
+        """Burn multiple over [now - window, now]. With history shorter
+        than the window the oldest reading is the baseline (rate over
+        the data we have — cold starts must not divide by fiction)."""
+        if not self._history:
+            return 0.0
+        latest = self._history[-1]
+        baseline = self._history[0]
+        cutoff = now - window
+        for reading in reversed(self._history):
+            if reading.ts <= cutoff:
+                baseline = reading
+                break
+        delta_total = latest.total - baseline.total
+        if delta_total <= 0:
+            return 0.0
+        delta_bad = delta_total - (latest.good - baseline.good)
+        ratio = min(1.0, max(0.0, delta_bad) / delta_total)
+        return ratio / (1.0 - self.target)
+
+    def evaluate(self, now: float | None = None) -> Alert:
+        """Advance the state machine against the current history and
+        return this objective's alert state."""
+        now = time.time() if now is None else now
+        with self._lock:
+            burn_fast = min(self._burn(w, now) for w in FAST_WINDOWS)
+            burn_slow = min(self._burn(w, now) for w in SLOW_WINDOWS)
+            if burn_fast >= FAST_BURN:
+                severity = "page"
+            elif burn_slow >= SLOW_BURN:
+                severity = "ticket"
+            else:
+                severity = ""
+            if severity:
+                if self._state == OK:
+                    self._state, self._since = PENDING, now
+                elif (self._state == PENDING
+                        and now - (self._since or now) >= self.for_s):
+                    self._state = FIRING
+            else:
+                self._state, self._since = OK, None
+            return Alert(
+                slo=self.name, state=self._state, target=self.target,
+                severity=severity if self._state != OK else "",
+                since=self._since, burn_fast=burn_fast,
+                burn_slow=burn_slow, description=self.description,
+            )
+
+
+# -- the serving fleet's standard objectives --------------------------------
+
+
+def availability_source(snapshot: FleetSnapshot) -> tuple[float, float]:
+    """good = responses that were not 5xx (client errors are the
+    client's problem, not an availability burn)."""
+    total = snapshot.value_sum("tpu_serve_requests_total")
+    bad = snapshot.value_sum(
+        "tpu_serve_requests_total",
+        where=lambda labels: labels.get("code", "").startswith("5"),
+    )
+    return total - bad, total
+
+
+def threshold_source(histogram: str, threshold_s: float,
+                     ) -> Callable[[FleetSnapshot], tuple[float, float]]:
+    """good = observations at or under ``threshold_s``, read from the
+    cumulative bucket whose bound is the smallest ``le ≥ threshold``
+    (the p99-style latency SLO: X% of requests under Y seconds)."""
+
+    def source(snapshot: FleetSnapshot) -> tuple[float, float]:
+        buckets = snapshot.histogram_buckets(histogram)
+        total = snapshot.histogram_count(histogram)
+        good = total
+        for le, count in buckets:  # sorted ascending
+            if le >= threshold_s:
+                good = count
+                break
+        return good, total
+
+    return source
+
+
+def default_slos(availability_target: float = 0.999,
+                 latency_threshold_s: float = 1.0,
+                 latency_target: float = 0.99,
+                 ttft_threshold_s: float = 2.5,
+                 ttft_target: float = 0.95,
+                 for_s: float = 60.0) -> list[SLOTracker]:
+    """The serving fleet's standard objectives — what the ``monitor``
+    CLI evaluates unless handed something else."""
+    return [
+        SLOTracker(
+            "availability", availability_target, availability_source,
+            for_s=for_s,
+            description="non-5xx responses / all responses",
+        ),
+        SLOTracker(
+            "latency", latency_target,
+            threshold_source("tpu_serve_request_seconds",
+                             latency_threshold_s),
+            for_s=for_s,
+            description=(
+                f"requests served within {latency_threshold_s:g}s"
+            ),
+        ),
+        SLOTracker(
+            "ttft", ttft_target,
+            threshold_source("tpu_serve_time_to_first_token_seconds",
+                             ttft_threshold_s),
+            for_s=for_s,
+            description=(
+                f"streams first token within {ttft_threshold_s:g}s"
+            ),
+        ),
+    ]

@@ -13,6 +13,11 @@ A minimal stdlib server (zero dependencies, air-gap friendly) exposing:
                              histograms, tokens-generated and speculation
                              counters, compile-cache hits — scrape-ready
                              (docs/guide/observability.md)
+  GET  /debug/trace/<id>   → the span tree of one request — queue wait,
+                             batch/prefill/decode — looked up by the id
+                             every response returns in ``X-Request-Id``
+                             (inbound ``X-Request-Id`` is honored, so a
+                             gateway's id traces end-to-end)
   GET  /v1/models          → the one resident model, OpenAI-list shaped
   POST /v1/completions     → {"prompt": str, "max_new_tokens"?: int,
                               "temperature"?: float, "top_k"?: int,
@@ -90,6 +95,7 @@ completes provision → import weights → quantize → serve-over-HTTP.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import json
 import os
 import sys
@@ -101,6 +107,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from tpu_kubernetes.obs import REGISTRY, events
 from tpu_kubernetes.obs import metrics as obs_metrics
 from tpu_kubernetes.util import log
+from tpu_kubernetes.util.trace import TRACER, span_tree
 
 # -- serving telemetry (obs/metrics.py): registered at import so every
 # family is present in GET /metrics from the first scrape, samples or not.
@@ -158,6 +165,11 @@ PROGRAM_CACHE = REGISTRY.counter(
     "compiled-program cache lookups (miss = a fresh jit wrapper)",
     labelnames=("result",),
 )
+INFLIGHT = REGISTRY.gauge(
+    "tpu_serve_inflight_requests",
+    "requests currently inside a handler (the server-side queue depth "
+    "a fleet monitor watches — generation serializes on one lock)",
+)
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -209,18 +221,31 @@ class _Batcher:
         self._thread = threading.Thread(target=self._dispatch, daemon=True)
         self._thread.start()
 
-    def submit(self, ids: list, max_new: int) -> list:
+    def enqueue(self, ids: list, max_new: int) -> dict:
+        """Queue a request; returns the entry. ``entry["dispatched"]``
+        fires when the dispatcher selects it into a batch (the end of its
+        queue wait) and ``entry["event"]`` when its result is ready —
+        split so the caller can time the two stages as separate trace
+        spans."""
         entry = {
             "ids": ids, "max_new": max_new, "t_enq": time.monotonic(),
-            "event": threading.Event(), "tokens": None, "error": None,
+            "event": threading.Event(), "dispatched": threading.Event(),
+            "tokens": None, "error": None,
         }
         with self._cond:
             self._queue.append(entry)
             self._cond.notify()
+        return entry
+
+    @staticmethod
+    def result(entry: dict) -> list:
         entry["event"].wait()
         if entry["error"] is not None:
             raise entry["error"]
         return entry["tokens"]
+
+    def submit(self, ids: list, max_new: int) -> list:
+        return self.result(self.enqueue(ids, max_new))
 
     def _dispatch(self) -> None:
         # the loop body may never raise: submit() blocks forever on a
@@ -260,6 +285,7 @@ class _Batcher:
                 now = time.monotonic()
                 for entry in batch:
                     QUEUE_SECONDS.observe(now - entry["t_enq"])
+                    entry["dispatched"].set()
                 BATCH_SIZE.observe(len(batch))
                 try:
                     self._run_batch(batch)
@@ -268,6 +294,7 @@ class _Batcher:
             for entry in batch:
                 if err is not None:
                     entry["error"] = err
+                entry["dispatched"].set()  # idempotent; covers the taint path
                 entry["event"].set()
             if rest:
                 # re-appending under the lock is enough: the dispatcher
@@ -434,6 +461,18 @@ class ServingState:
                 pass
         self.ready = True
         log.info("server: warm — default programs compiled, serving")
+
+    @contextlib.contextmanager
+    def _locked_phase(self):
+        """Acquire the generation lock under a "queue" span — on a busy
+        server the wait for the chip IS the queue, and a request's trace
+        should show it apart from the generation itself."""
+        with TRACER.phase("queue", quiet=True):
+            self._lock.acquire()
+        try:
+            yield
+        finally:
+            self._lock.release()
 
     def _cached_program(self, key, build):
         """Get-or-create a jitted program under the cache mutex. The
@@ -608,10 +647,11 @@ class ServingState:
         ck = self._cached_program(("lookup_chunk", k), _build_chunk)
 
         padded = self._pad_rows([ids], width)
-        logits, cache = pf(
-            self.params, jnp.asarray(padded),
-            lengths=jnp.asarray([len(ids)], jnp.int32),
-        )
+        with TRACER.phase("prefill", quiet=True):
+            logits, cache = pf(
+                self.params, jnp.asarray(padded),
+                lengths=jnp.asarray([len(ids)], jnp.int32),
+            )
         last = int(np.argmax(np.asarray(logits)[0]))
         emitted = [last]
         ctx = list(ids) + [last]
@@ -692,7 +732,7 @@ class ServingState:
     def _stream_lookup(self, ids, width, run_max_new, max_new,
                        finish: dict | None = None):
         """Stream the lookup loop's rounds as UTF-8-safe text deltas."""
-        with self._lock:
+        with self._locked_phase():
             yield from self._safe_deltas(
                 self._lookup_rounds(
                     ids, width, run_max_new, max_new, finish
@@ -716,28 +756,38 @@ class ServingState:
             # draft-free speculation: tokens are exactly the greedy
             # decode at this cache span, EOS-trimmed by the loop
             finish: dict = {}
-            with self._lock:
-                tokens = [
-                    t for new in self._lookup_rounds(
-                        ids, width, run_max_new, max_new, finish
-                    ) for t in new
-                ]
+            with self._locked_phase():
+                with TRACER.phase("batch", quiet=True, mode="lookup"):
+                    tokens = [
+                        t for new in self._lookup_rounds(
+                            ids, width, run_max_new, max_new, finish
+                        ) for t in new
+                    ]
             spec = finish.get("spec")
         elif self._batcher is not None and greedy_default:
             # greedy rows coalesce without changing output, by the
             # ragged-row identity (up to the documented cache-span
-            # float-tie caveat — the batch runs at the co-riders' span)
-            tokens = self._batcher.submit(ids, run_max_new)
+            # float-tie caveat — the batch runs at the co-riders' span).
+            # The queue span ends when the dispatcher SELECTS the entry,
+            # the batch span when its rows come back — the same boundary
+            # QUEUE_SECONDS measures.
+            entry = self._batcher.enqueue(ids, run_max_new)
+            with TRACER.phase("queue", quiet=True):
+                entry["dispatched"].wait()
+            with TRACER.phase("batch", quiet=True, mode="batched"):
+                tokens = self._batcher.result(entry)
         else:
             fn = self._program(run_max_new, float(temperature), int(top_k),
                                float(top_p))
-            with self._lock:
-                out = fn(
-                    self.params, jnp.asarray(self._pad_rows([ids], width)),
-                    rng=jax.random.PRNGKey(int(seed)),
-                    prompt_lengths=jnp.asarray([len(ids)], jnp.int32),
-                )
-                tokens = np.asarray(out)[0].tolist()
+            with self._locked_phase():
+                with TRACER.phase("batch", quiet=True, mode="solo"):
+                    out = fn(
+                        self.params,
+                        jnp.asarray(self._pad_rows([ids], width)),
+                        rng=jax.random.PRNGKey(int(seed)),
+                        prompt_lengths=jnp.asarray([len(ids)], jnp.int32),
+                    )
+                    tokens = np.asarray(out)[0].tolist()
         tokens = tokens[:max_new]              # bucketed run → requested budget
         if self.eos_id is not None and self.eos_id in tokens:
             tokens = tokens[:tokens.index(self.eos_id)]
@@ -746,8 +796,10 @@ class ServingState:
             # the lookup path already counted inside _lookup_rounds
             TOKENS_GENERATED.inc(len(tokens))
             PROMPT_TOKENS.inc(len(ids))
+        with TRACER.phase("decode", quiet=True, tokens=len(tokens)):
+            text = self.decode_text(tokens)
         result = {
-            "text": self.decode_text(tokens),
+            "text": text,
             "tokens": len(tokens),
             "prompt_tokens": len(ids),
             # the budget rule lives HERE (one place): a full budget means
@@ -840,10 +892,11 @@ class ServingState:
         def tokens():
             if self.ready:
                 PROMPT_TOKENS.inc(len(ids))
-            logits, cache = pf(
-                self.params, jnp.asarray(padded),
-                lengths=jnp.asarray([len(ids)], jnp.int32),
-            )
+            with TRACER.phase("prefill", quiet=True):
+                logits, cache = pf(
+                    self.params, jnp.asarray(padded),
+                    lengths=jnp.asarray([len(ids)], jnp.int32),
+                )
             tok = _sample(
                 logits, first_rng, float(temperature), int(top_k),
                 float(top_p),
@@ -863,7 +916,7 @@ class ServingState:
                     return
                 tok, cache = step(self.params, cache, tok, step_rngs[i])
 
-        with self._lock:
+        with self._locked_phase():
             yield from self._safe_deltas(tokens())
 
 
@@ -897,24 +950,52 @@ class _Handler(BaseHTTPRequestHandler):
             self._get()
 
     def do_POST(self):  # noqa: N802
-        # each request is one correlated run: events it emits (the closing
-        # summary below included) share one id, greppable in the JSONL stream
-        with events.run_context(), self._observed():
+        with self._observed():
             self._post()
-            events.emit("http_request", path=self.path,
-                        code=getattr(self, "_code", 0))
+
+    def _endpoint(self) -> str:
+        # /debug/trace/<run-id> collapses to one label value: the id is
+        # per-request, and metric label cardinality must stay bounded
+        if self.path.startswith("/debug/trace"):
+            return "/debug/trace"
+        return self.path if self.path in self._ENDPOINTS else "other"
+
+    def send_response(self, code, message=None):
+        super().send_response(code, message)
+        # EVERY response — success, error (send_error funnels through
+        # here too), SSE — returns the request's correlation id, so a
+        # client can quote it and GET /debug/trace/<id> can answer
+        rid = getattr(self, "_rid", "")
+        if rid:
+            self.send_header("X-Request-Id", rid)
 
     @contextlib.contextmanager
     def _observed(self):
-        """Count + time this request into the registry whichever way the
-        handler exits (the status code is whatever _json/_stream_sse last
-        wrote; a handler crash counts as 500)."""
-        endpoint = self.path if self.path in self._ENDPOINTS else "other"
+        """Correlate + count + time this request. An inbound
+        X-Request-Id becomes the run id (distributed callers propagate
+        theirs end-to-end) or one is minted; the whole handler runs
+        under it, so every span and event inside carries it and the
+        closing http_request event is greppable by it. The registry is
+        observed whichever way the handler exits (the status code is
+        whatever _json/_stream_sse last wrote; a handler crash counts
+        as 500)."""
+        endpoint = self._endpoint()
+        inbound = (self.headers.get("X-Request-Id") or "").strip()
+        self._rid = inbound[:64] or events.new_id()
         self._code = 500
         self._t0 = time.monotonic()
+        INFLIGHT.inc()
         try:
-            yield
+            with events.run_context(self._rid):
+                try:
+                    with TRACER.phase("request", quiet=True,
+                                      endpoint=endpoint):
+                        yield
+                finally:
+                    events.emit("http_request", path=self.path,
+                                code=getattr(self, "_code", 0))
         finally:
+            INFLIGHT.dec()
             REQUESTS_TOTAL.labels(endpoint, str(self._code)).inc()
             REQUEST_SECONDS.labels(endpoint).observe(
                 time.monotonic() - self._t0
@@ -939,6 +1020,18 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return None
+        if self.path.startswith("/debug/trace/"):
+            # the span tree of one request/run, looked up by the id the
+            # response's X-Request-Id header carried
+            rid = self.path[len("/debug/trace/"):]
+            tree = span_tree(TRACER.spans, rid)
+            if not tree:
+                return self._json(404, {
+                    "error": f"no spans recorded for run {rid!r}",
+                    "hint": "pass an X-Request-Id a response returned; "
+                            "old runs age out of the span ring",
+                })
+            return self._json(200, {"run": rid, "spans": tree})
         if self.path != "/healthz":
             return self._json(404, {"error": "unknown path"})
         if not st.ready:
@@ -1075,10 +1168,16 @@ class _Handler(BaseHTTPRequestHandler):
 
         _FAILED = object()   # mid-stream generation error sentinel
 
+        # contextvars do NOT cross thread creation: capture the handler's
+        # context (run id + the open request span) so the producer's
+        # "decode" span lands in THIS request's trace, not orphaned
+        ctx = contextvars.copy_context()
+
         def produce():
             try:
-                for piece in pieces:
-                    q.put(piece)
+                with TRACER.phase("decode", quiet=True):
+                    for piece in pieces:
+                        q.put(piece)
                 q.put(None)
             except Exception as e:  # noqa: BLE001 — surfaced via sentinel
                 log.warn(f"stream producer failed: {type(e).__name__}: {e}")
@@ -1103,7 +1202,9 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             failed = False
             if first is not None:
-                producer = threading.Thread(target=produce, daemon=True)
+                producer = threading.Thread(
+                    target=lambda: ctx.run(produce), daemon=True
+                )
                 producer.start()
                 self._write_sse(first, chat, sid, created)
                 while (piece := q.get()) is not None:

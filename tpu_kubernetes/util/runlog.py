@@ -16,6 +16,7 @@ this run spend its time on" and "how has terraform been behaving here".
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from typing import Any
 
@@ -25,6 +26,24 @@ from tpu_kubernetes.util.trace import TRACER
 # the metric families snapshotted into run reports (the terraform layer —
 # per-run phases already cover the workflow itself)
 REPORT_METRIC_PREFIX = "tpu_tf_"
+
+# runs/ retention: reports kept per manager (the backends prune on write,
+# newest kept). One policy for every backend so `get runs` reads the same
+# horizon whether the state lives on disk or in a bucket.
+DEFAULT_RUNS_KEEP = 50
+
+
+def runs_keep(default: int | None = None) -> int:
+    """How many run reports to retain per manager. ``TPU_K8S_RUNS_KEEP``
+    wins; otherwise ``default`` (a backend's configured cap) or the
+    project default. Never below 1 — the latest run must survive."""
+    raw = os.environ.get("TPU_K8S_RUNS_KEEP", "")
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass  # a bad override must not break persisting the report
+    return max(1, default if default is not None else DEFAULT_RUNS_KEEP)
 
 
 def record_run(

@@ -64,7 +64,10 @@ class Tracer:
             return list(self._spans)
 
     @contextlib.contextmanager
-    def phase(self, name: str, **meta):
+    def phase(self, name: str, quiet: bool = False, **meta):
+        """Record a span over the block. ``quiet`` suppresses the
+        progress lines only (per-request server spans must not spam
+        stderr) — recording and event emission are unaffected."""
         span = Span(
             name=name, start=time.monotonic(), meta=dict(meta),
             span_id=events.new_id(),
@@ -78,7 +81,7 @@ class Tracer:
             "span_start", span=span.span_id, parent=span.parent_id,
             name=name, **meta,
         )
-        show = self.enabled and log.level() >= log.NORMAL
+        show = self.enabled and not quiet and log.level() >= log.NORMAL
         if show:
             print(f"[tpu-k8s] ▶ {name}", file=self.stream)
         try:
@@ -124,6 +127,31 @@ class Tracer:
 
     def dump_json(self) -> str:
         return json.dumps(self.report())
+
+
+def span_tree(spans: list[Span], run_id: str) -> list[dict]:
+    """The spans of one run as a nested tree (parent links resolved) —
+    what ``GET /debug/trace/<run_id>`` serves. Spans whose parent was
+    evicted from the ring (or lives in another process) become roots, so
+    a partial history still renders."""
+    mine = [s for s in spans if s.run_id == run_id]
+    nodes = {
+        s.span_id: {
+            "name": s.name,
+            "seconds": round(s.seconds, 6),
+            **({"meta": s.meta} if s.meta else {}),
+            "children": [],
+        }
+        for s in mine
+    }
+    roots: list[dict] = []
+    for s in mine:
+        parent = nodes.get(s.parent_id) if s.parent_id else None
+        if parent is not None and parent is not nodes[s.span_id]:
+            parent["children"].append(nodes[s.span_id])
+        else:
+            roots.append(nodes[s.span_id])
+    return roots
 
 
 # module-level default tracer; workflows use this unless handed another
