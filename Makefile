@@ -3,7 +3,7 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast obs-check monitor-check bench dryrun native dist dist-offline clean
+.PHONY: test test-fast obs-check monitor-check perf-check bench dryrun native dist dist-offline clean
 
 test:
 	python -m pytest tests/ -q
@@ -33,6 +33,17 @@ obs-check:
 # with both instance labels (the ISSUE acceptance path).
 monitor-check:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_obs.py -q -m "not slow"
+
+# Perf gate: the CPU-deterministic microbench suites (obs/perfbench.py)
+# checked against the committed baseline. The 5x threshold is deliberately
+# generous — cross-machine wall-clock varies, and this gate exists to
+# catch catastrophic regressions (a lost jit, an accidental O(n^2)), not
+# single-digit drift; same-machine drift is what the default 1.5x
+# threshold against benchmarks/history/ is for.
+perf-check:
+	JAX_PLATFORMS=cpu python -m tpu_kubernetes bench run --suite all \
+	  --check --baseline benchmarks/baseline.jsonl --threshold 5.0 \
+	  --n 3 --warmup 2
 
 bench:
 	python bench.py

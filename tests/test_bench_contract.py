@@ -1,0 +1,65 @@
+"""bench.py driver-contract degradation (satellite of ISSUE 3).
+
+BENCH_r05.json showed the failure mode: the accelerator probe exhausts
+its tries and the child process dies with a raw RuntimeError traceback.
+The documented contract is in-band degradation — one JSON line with an
+``"error"`` field, exit 0 — and these tests pin it at both layers:
+the child (``--section``) mode end-to-end in a subprocess with a bogus
+platform, and the BackendUnavailable plumbing as units."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _bench_env():
+    env = dict(os.environ)
+    # a platform jax cannot initialize → the probe subprocess fails fast
+    # (rc != 0) instead of hanging, keeping this test cheap
+    env["JAX_PLATFORMS"] = "no-such-platform"
+    env["BENCH_PROBE_TRIES"] = "1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("TPU_ACCELERATOR_TYPE", None)
+    return env
+
+
+@pytest.mark.slow
+def test_section_child_probe_failure_degrades_in_band():
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--section", "dense"],
+        capture_output=True, text=True, timeout=240,
+        env=_bench_env(), cwd=str(REPO),
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
+    assert lines, "child printed nothing to stdout"
+    payload = json.loads(lines[-1])
+    assert "error" in payload
+    assert "unavailable" in payload["error"]
+    # the whole point: no raw traceback anywhere
+    assert "Traceback" not in r.stdout
+    assert "Traceback" not in r.stderr
+
+
+def test_probe_backend_raises_backend_unavailable(monkeypatch):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_module", REPO / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    monkeypatch.setenv("JAX_PLATFORMS", "no-such-platform")
+    with pytest.raises(bench.BackendUnavailable):
+        bench.probe_backend(max_tries=1, probe_timeout_s=60.0)
+    # the in-band class is a RuntimeError subtype, so existing callers
+    # that caught RuntimeError keep working
+    assert issubclass(bench.BackendUnavailable, RuntimeError)

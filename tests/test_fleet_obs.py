@@ -328,3 +328,32 @@ def test_render_table_rows_and_alerts(two_workers):
     payload = snapshot_json(snap, rows, [firing])
     assert payload["alerts"][0]["state"] == "firing"
     json.dumps(payload)                        # JSON-serializable whole
+
+
+def test_build_info_version_flows_to_fleet_rows():
+    """tpu_k8s_build_info{version} rides the scrape: the aggregator keeps
+    it per instance and `monitor` surfaces it in the VER column — a
+    half-rolled-out fleet is visible from one table."""
+    import tpu_kubernetes
+    from tpu_kubernetes.obs.metrics import register_build_info
+
+    reg = _serving_registry()
+    register_build_info(reg)
+    exp = _Exporter(reg)
+    try:
+        snap = FleetAggregator([exp.target]).scrape_once()
+        assert snap.label_value(
+            "tpu_k8s_build_info", "version"
+        ) == tpu_kubernetes.__version__
+        rows = fleet_rows(snap)
+        assert rows[0]["version"] == tpu_kubernetes.__version__
+        assert tpu_kubernetes.__version__ in render_table(rows, [], ts=snap.ts)
+    finally:
+        exp.stop()
+
+
+def test_fleet_rows_version_absent_is_none(two_workers):
+    a, b = two_workers          # synthetic registries carry no build_info
+    snap = FleetAggregator([a.target, b.target]).scrape_once()
+    assert snap.label_value("tpu_k8s_build_info", "version") is None
+    assert all(r["version"] is None for r in fleet_rows(snap))

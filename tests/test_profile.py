@@ -1,0 +1,197 @@
+"""obs/profile.py — device-synced phase profiler units.
+
+Compile-vs-execute keying, device sync, summary/stat shapes, the
+bounded record ring, tracer meta integration, and the CPU-graceful HBM
+sampler. Fresh Registry instances throughout — the process REGISTRY
+stays untouched."""
+
+from __future__ import annotations
+
+import io
+
+import pytest
+
+from tpu_kubernetes.obs.metrics import Registry
+from tpu_kubernetes.obs.profile import (
+    PhaseProfiler,
+    device_memory_stats,
+    fetch_profile,
+    render_profile,
+)
+
+
+def _profiler(**kw):
+    return PhaseProfiler(registry=Registry(), sample_hbm=False, **kw)
+
+
+def test_first_call_is_compile_then_execute():
+    p = _profiler()
+    with p.phase("step", key="k") as h:
+        assert h.mode == "compile"
+    with p.phase("step", key="k") as h:
+        assert h.mode == "execute"
+    with p.phase("step", key="k") as h:
+        assert h.mode == "execute"
+    s = p.summary()["phases"]["step"]
+    assert s["compile"]["count"] == 1
+    assert s["execute"]["count"] == 2
+
+
+def test_distinct_keys_compile_separately():
+    p = _profiler()
+    with p.phase("prefill", key=("prefill", 32)) as h:
+        assert h.mode == "compile"
+    with p.phase("prefill", key=("prefill", 64)) as h:
+        assert h.mode == "compile"       # a different program compiles too
+    with p.phase("prefill", key=("prefill", 32)) as h:
+        assert h.mode == "execute"
+    s = p.summary()["phases"]["prefill"]
+    assert s["compile"]["count"] == 2
+    assert s["execute"]["count"] == 1
+
+
+def test_exception_does_not_consume_first_call():
+    p = _profiler()
+    with pytest.raises(RuntimeError):
+        with p.phase("step", key="k"):
+            raise RuntimeError("trace failed")
+    # the failed block recorded nothing and the NEXT call still compiles
+    assert p.summary()["phases"] == {}
+    with p.phase("step", key="k") as h:
+        assert h.mode == "compile"
+
+
+def test_sync_blocks_on_device_value():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    p = _profiler()
+    fn = jax.jit(lambda x: x * 2)
+    with p.phase("mul", key="mul") as h:
+        out = h.sync(fn(jnp.ones((8,))))
+    assert float(out[0]) == 2.0
+    assert p.summary()["phases"]["mul"]["compile"]["count"] == 1
+
+
+def test_sync_tolerates_host_values():
+    p = _profiler()
+    with p.phase("host") as h:
+        assert h.sync(42) == 42    # non-device values must not crash exit
+
+
+def test_observe_spreads_calls():
+    p = _profiler()
+    p.observe("step", 1.0, mode="execute", calls=10)
+    d = p.summary()["phases"]["step"]["execute"]
+    assert d["count"] == 10
+    assert d["total_seconds"] == pytest.approx(1.0)
+    assert d["mean_seconds"] == pytest.approx(0.1)
+
+
+def test_compile_overhead_in_summary():
+    p = _profiler()
+    p.observe("step", 2.0, mode="compile")
+    p.observe("step", 1.0, mode="execute", calls=10)   # 0.1 s/step steady
+    s = p.summary()["phases"]["step"]
+    assert s["compile_overhead_seconds"] == pytest.approx(2.0 - 0.1)
+
+
+def test_mark_first_checks_and_marks():
+    p = _profiler()
+    assert p.mark_first("decode", ("step", 0.0)) is True
+    assert p.mark_first("decode", ("step", 0.0)) is False
+    assert p.mark_first("decode", ("step", 1.0)) is True
+
+
+def test_record_ring_is_bounded():
+    p = _profiler(max_records=4)
+    for i in range(10):
+        p.observe("step", 0.001, mode="execute", i=i)
+    recs = p.records(100)
+    assert len(recs) == 4
+    assert recs[-1]["meta"]["i"] == 9
+    # aggregates stay exact past the ring
+    assert p.summary()["phases"]["step"]["execute"]["count"] == 10
+
+
+def test_wrap_decorator_times_calls():
+    p = _profiler()
+
+    @p.wrap("work", key="w")
+    def work(x):
+        return x + 1
+
+    assert work(1) == 2
+    assert work(2) == 3
+    s = p.summary()["phases"]["work"]
+    assert s["compile"]["count"] == 1
+    assert s["execute"]["count"] == 1
+
+
+def test_tracer_span_carries_mode_meta():
+    from tpu_kubernetes.obs import events
+    from tpu_kubernetes.util.trace import Tracer, span_tree
+
+    p = _profiler()
+    tr = Tracer(stream=io.StringIO())
+    with events.run_context("run-1"):
+        with p.phase("prefill", key="pf", tracer=tr, width=16):
+            pass
+    tree = span_tree(tr.spans, "run-1")
+    assert len(tree) == 1
+    meta = tree[0]["meta"]
+    assert meta["mode"] == "compile"
+    assert meta["width"] == 16
+    assert meta["device_seconds"] >= 0
+
+
+def test_histogram_lands_in_registry():
+    reg = Registry()
+    p = PhaseProfiler(registry=reg, metric="tpu_test_phase_seconds",
+                      sample_hbm=False)
+    with p.phase("prefill", key="a"):
+        pass
+    text = reg.render()
+    assert 'tpu_test_phase_seconds_count{mode="compile",phase="prefill"}' \
+        in text or 'phase="prefill"' in text
+
+
+def test_reset_clears_everything():
+    p = _profiler()
+    with p.phase("step", key="k"):
+        pass
+    p.reset()
+    assert p.summary()["phases"] == {}
+    with p.phase("step", key="k") as h:
+        assert h.mode == "compile"
+
+
+def test_device_memory_stats_graceful():
+    # CPU backends either report stats or None — never raise
+    stats = device_memory_stats()
+    assert stats is None or (
+        isinstance(stats, dict)
+        and all(isinstance(v, int) for v in stats.values())
+    )
+
+
+def test_render_profile_table():
+    p = _profiler()
+    p.observe("prefill", 0.5, mode="compile")
+    p.observe("prefill", 0.01, mode="execute", calls=5)
+    p.observe("decode", 0.02, mode="execute", calls=7)
+    text = render_profile(p.summary())
+    assert "prefill" in text and "decode" in text
+    assert "compile" in text and "execute" in text
+    assert "compile overhead" in text
+
+
+def test_render_profile_empty():
+    assert "no phases recorded" in render_profile({"phases": {}})
+
+
+def test_fetch_profile_normalizes_target():
+    # bad port → URLError, but only after the URL was built — proves the
+    # host:port form normalizes without a scheme or path
+    with pytest.raises(Exception):
+        fetch_profile("127.0.0.1:1", timeout=0.2)

@@ -841,3 +841,64 @@ def test_batched_trace_has_queue_and_batch_spans():
         assert children["batch"]["meta"]["mode"] == "batched"
     finally:
         srv.shutdown()
+
+def test_debug_profile_splits_compile_from_execute(server):
+    """GET /debug/profile: warm() already ran a full completion AND a full
+    stream drain before ready, so both prefill and decode phases carry a
+    compile observation (each program's first call) and a steady-state
+    execute aggregate — the acceptance shape for the profiling layer."""
+    # one live request so the profile reflects steady-state traffic too
+    status, _ = _request(
+        server, "POST", "/v1/completions",
+        {"prompt": "profile me", "max_new_tokens": 2},
+    )
+    assert status == 200
+    status, data = _request(server, "GET", "/debug/profile")
+    assert status == 200
+    phases = data["phases"]
+    assert "prefill" in phases and "decode" in phases
+    assert phases["prefill"]["compile"]["count"] >= 1
+    assert phases["decode"]["compile"]["count"] >= 1
+    # warm's stream drained 7 post-first steps → execute aggregate exists
+    assert phases["decode"]["execute"]["count"] >= 1
+    # compile includes trace+compile, so per-call it dominates steady state
+    pf = phases["prefill"]
+    if pf.get("execute"):
+        assert pf["compile"]["mean_seconds"] >= pf["execute"]["mean_seconds"]
+    assert "compile_overhead_seconds" in pf
+    assert data["metric"] == "tpu_serve_phase_seconds"
+
+
+def test_serve_phase_metric_exported(server):
+    host, port = server.server_address[:2]
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    text = resp.read().decode()
+    conn.close()
+    assert resp.status == 200
+    assert "# TYPE tpu_serve_phase_seconds histogram" in text
+    assert 'phase="prefill"' in text
+    assert 'mode="compile"' in text
+
+
+def test_get_profile_cli_renders_live_server(server, capsys):
+    from tpu_kubernetes.cli.main import main
+
+    host, port = server.server_address[:2]
+    assert main(["get", "profile", "--target", f"{host}:{port}"]) == 0
+    out = capsys.readouterr().out
+    assert "prefill" in out and "decode" in out
+    assert "compile" in out and "execute" in out
+
+    assert main(["get", "profile", "--target", f"{host}:{port}",
+                 "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert "prefill" in payload["phases"]
+
+
+def test_get_profile_cli_unreachable_target_fails(capsys):
+    from tpu_kubernetes.cli.main import main
+
+    assert main(["get", "profile", "--target", "127.0.0.1:9"]) == 1
+    assert "profile" in capsys.readouterr().err.lower()

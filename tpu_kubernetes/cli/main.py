@@ -75,10 +75,13 @@ def build_parser() -> argparse.ArgumentParser:
     get = sub.add_parser(
         "get",
         help="query a manager or cluster, fetch a kubeconfig, list "
-             "recorded workflow runs, or dump in-process metrics",
+             "recorded workflow runs, dump in-process metrics, or render "
+             "a serving worker's phase-profile breakdown",
     )
     get.add_argument(
-        "kind", choices=["manager", "cluster", "kubeconfig", "runs", "metrics"]
+        "kind",
+        choices=["manager", "cluster", "kubeconfig", "runs", "metrics",
+                 "profile"],
     )
     get.add_argument(
         "--manager", metavar="NAME",
@@ -86,7 +89,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     get.add_argument(
         "--json", dest="as_json", action="store_true",
-        help="with runs: dump every recorded report as JSON",
+        help="with runs/profile: dump the raw JSON instead of the table",
+    )
+    get.add_argument(
+        "--target", metavar="HOST:PORT", default="127.0.0.1:8000",
+        help="with profile: the serving worker to query "
+             "(default 127.0.0.1:8000)",
     )
 
     repair = sub.add_parser(
@@ -136,6 +144,55 @@ def build_parser() -> argparse.ArgumentParser:
         help="one scrape cycle, then exit (scripting/smoke checks)",
     )
 
+    bench = sub.add_parser(
+        "bench",
+        help="run the in-tree CPU-deterministic microbenchmark suites "
+             "(obs/perfbench.py): median-of-N timings appended to "
+             "benchmarks/history/, with optional regression gating",
+    )
+    bench.add_argument("action", choices=["run"])
+    bench.add_argument(
+        "--suite", choices=["ops", "serve", "train", "all"], default="all",
+        help="which bench suite to run (default all)",
+    )
+    bench.add_argument(
+        "--check", action="store_true",
+        help="compare against the rolling baseline and exit 3 on "
+             "regression",
+    )
+    bench.add_argument(
+        "--json", dest="as_json", action="store_true",
+        help="emit one JSON object instead of the table",
+    )
+    bench.add_argument(
+        "--baseline", metavar="FILE",
+        help="JSONL baseline file for --check (default: the suite's own "
+             "history under --history-dir)",
+    )
+    bench.add_argument(
+        "--threshold", type=float, default=1.5, metavar="RATIO",
+        help="regression when current > RATIO x baseline (default 1.5)",
+    )
+    bench.add_argument(
+        "--n", type=int, default=5, metavar="N",
+        help="timed iterations per bench; the median is recorded "
+             "(default 5)",
+    )
+    bench.add_argument(
+        "--warmup", type=int, default=2, metavar="N",
+        help="untimed warmup iterations (absorbs jit trace+compile; "
+             "default 2)",
+    )
+    bench.add_argument(
+        "--only", metavar="SUBSTR",
+        help="run only benches whose name contains SUBSTR",
+    )
+    bench.add_argument(
+        "--history-dir", default="benchmarks/history", metavar="DIR",
+        help="where per-suite JSONL history accumulates "
+             "(default benchmarks/history)",
+    )
+
     sub.add_parser("version", help="print the version")
     return parser
 
@@ -163,6 +220,35 @@ def main(argv: list[str] | None = None) -> int:
             targets, interval=args.interval, once=args.once,
             as_json=args.as_json,
         )
+
+    if args.command == "bench":
+        # microbenches need jax, not a backend/config — short-circuit
+        # like monitor (obs/perfbench.py owns the run/check logic)
+        from tpu_kubernetes.obs.perfbench import run as perfbench_run
+
+        return perfbench_run(
+            args.suite, check=args.check, as_json=args.as_json,
+            history_dir=args.history_dir, baseline=args.baseline,
+            threshold=args.threshold, n=args.n, warmup=args.warmup,
+            only=args.only,
+        )
+
+    if args.command == "get" and args.kind == "profile":
+        # a remote worker's GET /debug/profile, rendered — no backend,
+        # config, or prompts involved
+        from tpu_kubernetes.obs.profile import fetch_profile, render_profile
+
+        try:
+            data = fetch_profile(args.target)
+        except Exception as e:  # noqa: BLE001 — network errors → exit 1
+            print(f"error: cannot fetch profile from {args.target}: {e}",
+                  file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps(data, indent=2, sort_keys=True))
+        else:
+            print(render_profile(data), end="")
+        return 0
 
     if args.command == "get" and args.kind == "metrics":
         # this process's registry (terraform command families registered by

@@ -23,6 +23,17 @@ single-process path):
   multi-window burn-rate alerting over fleet snapshots.
 * :mod:`tpu_kubernetes.obs.monitor` — the ``tpu-kubernetes monitor``
   fleet table / JSON renderer.
+
+Performance attribution (also lazy — profile needs no jax at import,
+perfbench imports jax only when benches run):
+
+* :mod:`tpu_kubernetes.obs.profile` — device-synced phase profiler
+  separating compile (a program's first call: jit trace + XLA compile)
+  from steady-state execute time, with HBM watermarks where the backend
+  reports them; feeds ``GET /debug/profile`` and the span tree.
+* :mod:`tpu_kubernetes.obs.perfbench` — deterministic CPU-runnable
+  microbench registry with JSONL history under ``benchmarks/history/``
+  and a rolling-baseline regression gate (``bench run --check``).
 """
 
 from tpu_kubernetes.obs.metrics import (  # noqa: F401

@@ -110,6 +110,24 @@ class FleetSnapshot:
                  ) -> float | None:
         return expfmt.bucket_quantile(self.histogram_buckets(name, where), q)
 
+    def label_value(self, family: str, label: str,
+                    where: Callable[[dict[str, str]], bool] | None = None,
+                    ) -> str | None:
+        """First matching sample's value for one LABEL — how ``*_info``
+        idiom families are read (e.g. the ``version`` a worker's
+        ``tpu_k8s_build_info`` carries), where the sample value is a
+        constant 1 and the payload rides the labels."""
+        fam = self.families.get(family)
+        if fam is None:
+            return None
+        for s in fam.samples:
+            d = s.labels_dict()
+            if where is not None and not where(d):
+                continue
+            if label in d:
+                return d[label]
+        return None
+
 
 @dataclass
 class ScrapeResult:

@@ -332,3 +332,22 @@ class Registry:
 REGISTRY = Registry()
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def register_build_info(registry: Registry | None = None) -> Gauge:
+    """The Prometheus ``*_info`` idiom: a constant-1 gauge whose labels
+    carry the facts — here the package version, so a fleet scrape
+    (obs/aggregate.py keeps all families, instance-tagged) can answer
+    "which workers run which build" during a rollout."""
+    import tpu_kubernetes
+
+    fam = (registry if registry is not None else REGISTRY).gauge(
+        "tpu_k8s_build_info",
+        "build/version info; constant 1 — the version rides the label",
+        labelnames=("version",),
+    )
+    fam.labels(tpu_kubernetes.__version__).set(1.0)
+    return fam
+
+
+BUILD_INFO = register_build_info()

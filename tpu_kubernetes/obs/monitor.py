@@ -28,6 +28,7 @@ LATENCY = "tpu_serve_request_seconds"
 TTFT = "tpu_serve_time_to_first_token_seconds"
 TOKENS = "tpu_serve_tokens_generated_total"
 INFLIGHT = "tpu_serve_inflight_requests"
+BUILD_INFO = "tpu_k8s_build_info"
 
 
 def _of_instance(instance: str) -> Callable[[dict[str, str]], bool]:
@@ -48,6 +49,9 @@ def fleet_rows(snapshot: FleetSnapshot,
         row: dict[str, Any] = {
             "instance": instance,
             "up": health.up,
+            # per-instance build version (tpu_k8s_build_info) — a mixed
+            # column during a rollout is the point of carrying it here
+            "version": snapshot.label_value(BUILD_INFO, "version", mine),
             "consecutive_failures": health.consecutive_failures,
             "scrape_seconds": health.last_scrape_seconds,
             "error": health.last_error,
@@ -86,8 +90,8 @@ def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
     """The human rendering: one aligned row per instance, then any
     pending/firing alerts."""
     header = (
-        f"{'INSTANCE':<24} {'UP':>2} {'RPS':>8} {'P50':>8} {'P99':>8} "
-        f"{'TTFT99':>8} {'TOK/S':>8} {'QUEUE':>6}"
+        f"{'INSTANCE':<24} {'UP':>2} {'VER':>8} {'RPS':>8} {'P50':>8} "
+        f"{'P99':>8} {'TTFT99':>8} {'TOK/S':>8} {'QUEUE':>6}"
     )
     lines = []
     if ts is not None:
@@ -98,6 +102,7 @@ def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
     for row in rows:
         lines.append(
             f"{row['instance']:<24} {row['up']:>2}"
+            f" {(row.get('version') or '-'):>8}"
             f"{_fmt(row['rps'])}"
             f"{_fmt(row['p50_s'], 's', 9)}"
             f"{_fmt(row['p99_s'], 's', 9)}"

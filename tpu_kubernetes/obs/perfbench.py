@@ -1,0 +1,515 @@
+"""In-tree microbenchmark registry + perf history + regression detection.
+
+``bench.py`` at the repo root is the *driver's* benchmark — it runs on
+real accelerators and its numbers live outside the tree. This module is
+the opposite contract: small, deterministic, CPU-runnable microbenches
+over the hot paths (ops/ kernels' reference lanes, serve prefill +
+decode-step, the train step), whose median-of-N results append to a
+JSONL history under ``benchmarks/history/`` so every future perf PR is
+self-verifying: ``bench run --check`` compares the newest run against a
+rolling baseline and exits nonzero on regression.
+
+Methodology (why these choices):
+
+* **warmup then median**: jax's first call pays trace+compile; warmup
+  iterations absorb it, and the median of the timed iterations is
+  robust to a single GC pause or scheduler hiccup where a mean is not.
+* **device-synced**: every iteration blocks on the thunk's output —
+  async dispatch otherwise makes the numbers dispatch time.
+* **rolling baseline**: the per-metric median of the last K history
+  entries, so the baseline tracks the machine instead of a single
+  (possibly lucky) run.
+* **noise threshold**: a regression is ``current > threshold ×
+  baseline`` AND above an absolute floor — microsecond-scale metrics
+  jitter by ratios that mean nothing.
+
+``PERFBENCH_SLOWDOWN=name:factor`` multiplies a measured median — the
+self-test knob proving the detector trips (a synthetic 2× slowdown must
+make ``bench run --check`` exit nonzero).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable
+
+SUITES = ("ops", "serve", "train")
+DEFAULT_HISTORY_DIR = "benchmarks/history"
+DEFAULT_THRESHOLD = 1.5
+DEFAULT_WINDOW = 5
+# metrics where BOTH sides sit under this floor never regress: at that
+# scale the ratio is pure timer/scheduler noise
+MIN_SECONDS = 1e-4
+EXIT_REGRESSION = 3
+
+
+# --------------------------------------------------------------------------
+# registry
+
+@dataclass(frozen=True)
+class Bench:
+    name: str
+    suite: str
+    # factory builds inputs + jitted program and returns a zero-arg
+    # thunk for ONE iteration; the runner device-syncs its return value
+    make: Callable[[], Callable[[], Any]]
+
+
+BENCHES: dict[str, Bench] = {}
+
+
+def register(name: str, suite: str):
+    if suite not in SUITES:
+        raise ValueError(f"unknown suite {suite!r} (expected one of {SUITES})")
+
+    def deco(make: Callable[[], Callable[[], Any]]):
+        if name in BENCHES:
+            raise ValueError(f"duplicate bench {name!r}")
+        BENCHES[name] = Bench(name=name, suite=suite, make=make)
+        return make
+    return deco
+
+
+def benches_for(suite: str, only: str | None = None) -> list[Bench]:
+    picked = [
+        b for b in BENCHES.values()
+        if (suite == "all" or b.suite == suite)
+        and (only is None or only in b.name)
+    ]
+    return sorted(picked, key=lambda b: (b.suite, b.name))
+
+
+# --------------------------------------------------------------------------
+# bench definitions (jax imported lazily — registry must import anywhere)
+
+_TEST_MODEL = "llama-test"
+
+
+@register("ops.rms_norm", "ops")
+def _bench_rms_norm():
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_kubernetes.ops import rms_norm
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (64, 512), jnp.float32)
+    w = jnp.ones((512,), jnp.float32)
+    fn = jax.jit(rms_norm)
+    return lambda: fn(x, w)
+
+
+@register("ops.flash_attention", "ops")
+def _bench_flash_attention():
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_kubernetes.ops import flash_attention
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
+    shape = (1, 4, 128, 64)  # (batch, heads, seq, head_dim)
+    q = jax.random.normal(k1, shape, jnp.float32)
+    k = jax.random.normal(k2, shape, jnp.float32)
+    v = jax.random.normal(k3, shape, jnp.float32)
+    # reference lane: the XLA path every backend has — what CPU CI can
+    # hold steady; the Pallas lane is bench.py's (driver) job
+    fn = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, use_pallas=False))
+    return lambda: fn(q, k, v)
+
+
+@register("ops.grouped_matmul", "ops")
+def _bench_grouped_matmul():
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_kubernetes.ops import grouped_matmul
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(2))
+    lhs = jax.random.normal(k1, (256, 128), jnp.float32)
+    rhs = jax.random.normal(k2, (4, 128, 128), jnp.float32)
+    group_sizes = jnp.array([64, 64, 64, 64], jnp.int32)
+    fn = jax.jit(lambda l, r, g: grouped_matmul(l, r, g, use_pallas=False))
+    return lambda: fn(lhs, rhs, group_sizes)
+
+
+@register("serve.prefill", "serve")
+def _bench_serve_prefill():
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_kubernetes.models import CONFIGS, init_params
+    from tpu_kubernetes.models.decode import prefill
+
+    cfg = CONFIGS[_TEST_MODEL]
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(4), (2, 16), 0, cfg.vocab_size, jnp.int32)
+    fn = jax.jit(lambda p, t: prefill(p, t, cfg, max_seq=64)[0])
+    return lambda: fn(params, tokens)
+
+
+@register("serve.decode_step", "serve")
+def _bench_serve_decode_step():
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_kubernetes.models import CONFIGS, init_params
+    from tpu_kubernetes.models.decode import decode_step, prefill
+
+    cfg = CONFIGS[_TEST_MODEL]
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(4), (2, 16), 0, cfg.vocab_size, jnp.int32)
+    _, cache = prefill(params, tokens, cfg, max_seq=64)
+    tok = jnp.array([1, 2], jnp.int32)
+    fn = jax.jit(lambda p, c, t: decode_step(p, c, t, cfg)[0])
+    return lambda: fn(params, cache, tok)
+
+
+@register("train.step", "train")
+def _bench_train_step():
+    import functools
+
+    import jax
+
+    from tpu_kubernetes.models import CONFIGS
+    from tpu_kubernetes.train.trainer import (
+        TrainConfig,
+        init_state,
+        synthetic_batches,
+        train_step,
+    )
+
+    cfg = CONFIGS[_TEST_MODEL]
+    tc = TrainConfig(warmup_steps=2)
+    state = init_state(jax.random.PRNGKey(5), cfg, tc)
+    batch = next(synthetic_batches(cfg.vocab_size, 2, 32))
+    # no donation: the same state feeds every iteration, so each run
+    # performs the identical step (medians compare like with like)
+    fn = jax.jit(functools.partial(train_step, cfg=cfg, tc=tc))
+    return lambda: fn(state, batch)[1]
+
+
+# --------------------------------------------------------------------------
+# runner
+
+@dataclass
+class BenchResult:
+    name: str
+    suite: str
+    median_seconds: float
+    n: int
+    warmup: int
+    times: list[float] = field(default_factory=list)
+    injected: float | None = None  # PERFBENCH_SLOWDOWN factor, if applied
+
+
+def _slowdowns() -> dict[str, float]:
+    raw = os.environ.get("PERFBENCH_SLOWDOWN", "").strip()
+    out: dict[str, float] = {}
+    if not raw:
+        return out
+    for part in raw.split(","):
+        if ":" not in part:
+            continue
+        name, _, factor = part.partition(":")
+        try:
+            out[name.strip()] = float(factor)
+        except ValueError:
+            continue
+    return out
+
+
+def run_bench(b: Bench, n: int = 5, warmup: int = 2) -> BenchResult:
+    import jax
+
+    thunk = b.make()
+    for _ in range(max(0, warmup)):
+        jax.block_until_ready(thunk())
+    times: list[float] = []
+    for _ in range(max(1, n)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(thunk())
+        times.append(time.perf_counter() - t0)
+    median = sorted(times)[len(times) // 2]
+    injected = None
+    for name, factor in _slowdowns().items():
+        if name == b.name or name in b.name:
+            median *= factor
+            injected = factor
+            break
+    return BenchResult(
+        name=b.name, suite=b.suite, median_seconds=median,
+        n=len(times), warmup=warmup, times=[round(t, 6) for t in times],
+        injected=injected,
+    )
+
+
+def run_suite(suite: str, n: int = 5, warmup: int = 2,
+              only: str | None = None,
+              progress: Callable[[str], None] | None = None,
+              ) -> dict[str, BenchResult]:
+    results: dict[str, BenchResult] = {}
+    for b in benches_for(suite, only=only):
+        if progress:
+            progress(f"bench {b.name} ...")
+        results[b.name] = run_bench(b, n=n, warmup=warmup)
+    return results
+
+
+# --------------------------------------------------------------------------
+# history
+
+def history_path(history_dir: str | Path, suite: str) -> Path:
+    return Path(history_dir) / f"{suite}.jsonl"
+
+
+def load_history(path: str | Path) -> list[dict]:
+    """History entries, oldest first. Missing file → empty; malformed
+    lines are skipped (a truncated append must not break all checks)."""
+    p = Path(path)
+    if not p.exists():
+        return []
+    entries: list[dict] = []
+    for line in p.read_text(encoding="utf-8").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            entry = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(entry, dict) and isinstance(entry.get("results"), dict):
+            entries.append(entry)
+    return entries
+
+
+def make_entry(suite: str, results: dict[str, BenchResult],
+               n: int) -> dict:
+    import tpu_kubernetes
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:
+        backend = "unknown"
+    return {
+        "ts": round(time.time(), 3),
+        "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "suite": suite,
+        "version": tpu_kubernetes.__version__,
+        "backend": backend,
+        "n": n,
+        "results": {
+            name: round(r.median_seconds, 6) for name, r in results.items()
+        },
+    }
+
+
+def append_history(path: str | Path, entry: dict) -> Path:
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    with p.open("a", encoding="utf-8") as f:
+        f.write(json.dumps(entry, sort_keys=True) + "\n")
+    return p
+
+
+def rolling_baseline(entries: list[dict],
+                     window: int = DEFAULT_WINDOW) -> dict[str, float]:
+    """Per-metric median over each metric's last ``window`` observations.
+
+    Window applies per metric, not per entry: a metric added recently
+    still gets a baseline from however many observations it has (one
+    observation → that value IS the baseline; zero variance is fine —
+    the median of identical values is that value)."""
+    series: dict[str, list[float]] = {}
+    for entry in entries:  # oldest → newest
+        for name, value in entry.get("results", {}).items():
+            try:
+                series.setdefault(name, []).append(float(value))
+            except (TypeError, ValueError):
+                continue
+    out: dict[str, float] = {}
+    for name, values in series.items():
+        tail = values[-max(1, window):]
+        tail = sorted(tail)
+        mid = len(tail) // 2
+        out[name] = (tail[mid] if len(tail) % 2
+                     else (tail[mid - 1] + tail[mid]) / 2.0)
+    return out
+
+
+# --------------------------------------------------------------------------
+# regression detection
+
+@dataclass(frozen=True)
+class Check:
+    name: str
+    status: str  # "ok" | "regression" | "new" | "missing"
+    current: float | None
+    baseline: float | None
+    ratio: float | None
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name, "status": self.status,
+            "current_seconds": self.current, "baseline_seconds": self.baseline,
+            "ratio": self.ratio,
+        }
+
+
+@dataclass
+class Report:
+    checks: list[Check]
+    threshold: float
+
+    @property
+    def regressions(self) -> list[Check]:
+        return [c for c in self.checks if c.status == "regression"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.regressions
+
+    def as_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "threshold": self.threshold,
+            "checks": [c.as_dict() for c in self.checks],
+        }
+
+
+def detect(current: dict[str, float], baseline: dict[str, float],
+           threshold: float = DEFAULT_THRESHOLD,
+           min_seconds: float = MIN_SECONDS) -> Report:
+    """Compare a run against a baseline.
+
+    * metric in both → ``ok`` or ``regression`` (ratio > threshold and
+      at least one side above the noise floor);
+    * metric only in the run → ``new`` (a first observation cannot
+      regress — this is also the whole-history-empty case);
+    * metric only in the baseline → ``missing`` (reported, not failing:
+      benches get renamed/retired and a perf gate must not fossilize
+      the metric set).
+    """
+    checks: list[Check] = []
+    for name in sorted(set(current) | set(baseline)):
+        cur = current.get(name)
+        base = baseline.get(name)
+        if base is None:
+            checks.append(Check(name, "new", cur, None, None))
+            continue
+        if cur is None:
+            checks.append(Check(name, "missing", None, base, None))
+            continue
+        ratio = (cur / base) if base > 0 else float("inf")
+        noise_floor = max(cur, base) < min_seconds
+        status = ("regression"
+                  if ratio > threshold and not noise_floor else "ok")
+        checks.append(Check(name, status, cur, base, round(ratio, 3)))
+    return Report(checks=checks, threshold=threshold)
+
+
+# --------------------------------------------------------------------------
+# CLI entry (wired as `tpu-kubernetes bench run`)
+
+def _fmt_s(v: float | None) -> str:
+    return f"{v * 1e3:9.3f}ms" if v is not None else "        — "
+
+
+def run(suite: str = "all", *, check: bool = False, as_json: bool = False,
+        history_dir: str = DEFAULT_HISTORY_DIR, baseline: str | None = None,
+        threshold: float = DEFAULT_THRESHOLD, n: int = 5, warmup: int = 2,
+        only: str | None = None, window: int = DEFAULT_WINDOW,
+        out=None) -> int:
+    """Run a suite (or all), append history, optionally gate on
+    regressions. Returns the process exit code (0 ok, 3 regression)."""
+    out = out if out is not None else sys.stdout
+    suites = list(SUITES) if suite == "all" else [suite]
+
+    picked = {s: benches_for(s, only=only) for s in suites}
+    picked = {s: bs for s, bs in picked.items() if bs}
+    if not picked:
+        print(f"no benches match suite={suite!r} only={only!r}",
+              file=sys.stderr)
+        return 2
+
+    all_results: dict[str, BenchResult] = {}
+    reports: list[Report] = []
+    payload: dict[str, Any] = {"suites": {}, "threshold": threshold}
+
+    # an explicit --baseline file (the committed cross-machine baseline)
+    # replaces the per-suite local history as the comparison point
+    shared_baseline = (rolling_baseline(load_history(baseline), window)
+                      if baseline else None)
+
+    for s, bs in sorted(picked.items()):
+        results: dict[str, BenchResult] = {}
+        for b in bs:
+            results[b.name] = run_bench(b, n=n, warmup=warmup)
+        all_results.update(results)
+
+        hpath = history_path(history_dir, s)
+        base = (shared_baseline if shared_baseline is not None
+                else rolling_baseline(load_history(hpath), window))
+        current = {name: r.median_seconds for name, r in results.items()}
+        if shared_baseline is not None:
+            # scope the shared baseline to this suite's metrics so the
+            # other suites' metrics don't show up as "missing" here
+            base = {k: v for k, v in base.items() if k in current}
+        report = detect(current, base, threshold=threshold) if check else None
+
+        entry = make_entry(s, results, n)
+        append_history(hpath, entry)
+
+        payload["suites"][s] = {
+            "results": entry["results"],
+            "history": str(hpath),
+            **({"check": report.as_dict()} if report else {}),
+        }
+        if report:
+            reports.append(report)
+
+    rc = 0
+    if check and any(not r.ok for r in reports):
+        rc = EXIT_REGRESSION
+
+    if as_json:
+        payload["ok"] = rc == 0
+        print(json.dumps(payload, sort_keys=True), file=out)
+        return rc
+
+    checks_by_name = {
+        c.name: c for r in reports for c in r.checks
+    }
+    for name, r in sorted(all_results.items()):
+        line = f"{name:<24} {_fmt_s(r.median_seconds)}  (median of {r.n})"
+        if r.injected:
+            line += f"  [injected x{r.injected:g}]"
+        c = checks_by_name.get(name)
+        if c and c.baseline is not None:
+            line += f"  baseline {_fmt_s(c.baseline).strip()} x{c.ratio:g} {c.status}"
+        elif c:
+            line += f"  {c.status}"
+        print(line, file=out)
+    for c in checks_by_name.values():
+        if c.status == "missing":
+            print(f"{c.name:<24} missing from this run "
+                  f"(baseline {_fmt_s(c.baseline).strip()})", file=out)
+    if check:
+        bad = [c for r in reports for c in r.regressions]
+        if bad:
+            for c in bad:
+                print(
+                    f"REGRESSION: {c.name} x{c.ratio:g} over baseline "
+                    f"({_fmt_s(c.baseline).strip()} -> "
+                    f"{_fmt_s(c.current).strip()}, threshold "
+                    f"x{threshold:g})", file=out)
+        else:
+            print(f"perf check ok (threshold x{threshold:g})", file=out)
+    return rc

@@ -1,0 +1,327 @@
+"""Device-synced phase profiling: compile-vs-execute attribution + HBM.
+
+The metrics layer (obs/metrics.py) answers *what* is slow — request
+latency, step seconds. This module answers *why*: under jax's async
+dispatch a wall-clock timer around a jitted call measures dispatch, not
+work, and the first call of a program silently pays trace+compile. The
+TPU serving/training comparisons the roadmap targets stand or fall on
+separating those (PAPERS.md: Gemma-on-Cloud-TPU, Podracer), so
+:class:`PhaseProfiler` makes the split explicit:
+
+* ``phase(name, key=...)`` is a context manager that times a block and
+  classifies it as ``mode="compile"`` (first time this ``key`` runs —
+  trace+compile included) or ``mode="execute"`` (steady state). The
+  yielded handle's :meth:`~PhaseHandle.sync` registers device values to
+  ``jax.block_until_ready`` before the clock stops, so the recorded
+  time is device time, not dispatch time.
+* Each exit samples :func:`device_memory_stats` — HBM bytes-in-use /
+  peak watermark on TPU/GPU backends, a graceful ``None`` on CPU.
+* Pre-measured durations (the trainer's windowed step accounting, a
+  decode loop's accumulated tail) go in via :meth:`~PhaseProfiler.observe`.
+* Stats land in three sinks at once: a per-(phase, mode) histogram in
+  the process REGISTRY (scrape-ready), an exact running aggregate for
+  :meth:`~PhaseProfiler.summary` (what ``GET /debug/profile`` and
+  ``tpu-kubernetes get profile`` render), and — when a ``tracer`` is
+  passed — a child span whose ``meta`` carries mode + device seconds,
+  so per-request attribution shows up in ``GET /debug/trace/<id>``.
+
+No jax import at module load: the CLI renders remote profiles without
+an accelerator stack, and the serve server must import without jax.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import threading
+import time
+import urllib.request
+from collections import deque
+from dataclasses import dataclass, field
+
+from tpu_kubernetes.obs.metrics import DEFAULT_BUCKETS, REGISTRY, Registry
+
+COMPILE = "compile"
+EXECUTE = "execute"
+
+
+def _block_until_ready(value) -> None:
+    """Wait for device computation backing ``value`` (any pytree).
+    No-op when jax is unavailable or the value is host-only."""
+    try:
+        import jax
+    except Exception:
+        return
+    try:
+        jax.block_until_ready(value)
+    except Exception:
+        # host-only values (ints, strings) and closed backends must not
+        # turn a timing probe into a crash
+        pass
+
+
+def device_memory_stats():
+    """HBM stats of the first addressable device, or ``None``.
+
+    Returns ``{"bytes_in_use", "peak_bytes_in_use", "bytes_limit"}``
+    (whichever keys the backend reports) on TPU/GPU; ``None`` on CPU
+    backends that don't track memory, when jax is missing, or on any
+    backend error — profiling must never take the profiled process down.
+    """
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+    out = {k: int(stats[k]) for k in keep if k in stats}
+    return out or None
+
+
+@dataclass
+class PhaseHandle:
+    """Yielded by :meth:`PhaseProfiler.phase`; call :meth:`sync` on the
+    block's device outputs so the timer includes their computation."""
+
+    name: str
+    mode: str
+    _pending: object = None
+
+    def sync(self, value):
+        """Register ``value`` to be blocked on before the clock stops.
+        Returns ``value`` so it can wrap an expression in place."""
+        self._pending = value
+        return value
+
+
+@dataclass
+class _Stat:
+    count: int = 0
+    total: float = 0.0
+    min: float = float("inf")
+    max: float = 0.0
+    last: float = 0.0
+
+    def add(self, seconds: float, calls: int = 1) -> None:
+        self.count += calls
+        self.total += seconds
+        per = seconds / max(1, calls)
+        self.min = min(self.min, per)
+        self.max = max(self.max, per)
+        self.last = per
+
+    def as_dict(self) -> dict:
+        return {
+            "count": self.count,
+            "total_seconds": round(self.total, 6),
+            "mean_seconds": round(self.total / max(1, self.count), 6),
+            "min_seconds": round(self.min, 6),
+            "max_seconds": round(self.max, 6),
+            "last_seconds": round(self.last, 6),
+        }
+
+
+@dataclass
+class PhaseRecord:
+    name: str
+    mode: str
+    seconds: float
+    ts: float
+    meta: dict = field(default_factory=dict)
+    hbm: dict | None = None
+
+
+class PhaseProfiler:
+    """Thread-safe phase timer with first-call (compile) detection.
+
+    ``key`` identifies *a compiled program*: the first ``phase()`` entry
+    for a given ``(name, key)`` is recorded as ``mode="compile"`` (jit
+    trace + XLA compile ride on that call), every later one as
+    ``mode="execute"``. Omitting ``key`` keys on the name alone. A block
+    that raises does not consume first-call status — the compile really
+    happens on the next successful run.
+    """
+
+    def __init__(self, registry: Registry | None = None, *,
+                 metric: str = "tpu_profile_phase_seconds",
+                 help: str = "device-synced phase seconds by compile/execute mode",
+                 max_records: int = 2048,
+                 sample_hbm: bool = True,
+                 buckets=DEFAULT_BUCKETS):
+        self._registry = registry if registry is not None else REGISTRY
+        self.metric = metric
+        self._hist = self._registry.histogram(
+            metric, help, labelnames=("phase", "mode"), buckets=buckets)
+        self._lock = threading.Lock()
+        self._seen: set = set()
+        self._stats: dict[tuple[str, str], _Stat] = {}
+        self._records: deque[PhaseRecord] = deque(maxlen=max_records)
+        self._last_hbm: dict | None = None
+        self.sample_hbm = sample_hbm
+
+    def mark_first(self, name: str, key=None) -> bool:
+        """Check-and-mark first-call status for ``(name, key)`` without
+        opening a phase — for call sites that split one logical phase
+        across several timed regions (a decode loop's first step)."""
+        k = (name, key)
+        with self._lock:
+            first = k not in self._seen
+            self._seen.add(k)
+        return first
+
+    @contextlib.contextmanager
+    def phase(self, name: str, key=None, tracer=None, **meta):
+        """Time a block as phase ``name``. See class docstring.
+
+        ``tracer`` (a :class:`tpu_kubernetes.util.trace.Tracer`) opens a
+        nested quiet span and stamps mode / device seconds / HBM into
+        its ``meta`` so the request trace carries the attribution.
+        """
+        k = (name, key)
+        with self._lock:
+            first = k not in self._seen
+            self._seen.add(k)
+        mode = COMPILE if first else EXECUTE
+        handle = PhaseHandle(name=name, mode=mode)
+        ctx = (tracer.phase(name, quiet=True, **meta)
+               if tracer is not None else contextlib.nullcontext())
+        with ctx as span:
+            t0 = time.perf_counter()
+            try:
+                yield handle
+            except BaseException:
+                with self._lock:
+                    if first:
+                        self._seen.discard(k)
+                raise
+            _block_until_ready(handle._pending)
+            seconds = time.perf_counter() - t0
+            hbm = device_memory_stats() if self.sample_hbm else None
+            self._record(name, mode, seconds, meta=meta, hbm=hbm)
+            if span is not None:
+                span.meta["mode"] = mode
+                span.meta["device_seconds"] = round(seconds, 6)
+                if hbm and "peak_bytes_in_use" in hbm:
+                    span.meta["hbm_peak_mb"] = round(
+                        hbm["peak_bytes_in_use"] / 2**20, 1)
+
+    def wrap(self, name: str, key=None):
+        """Decorator form: times each call, syncing the return value."""
+        def deco(fn):
+            @functools.wraps(fn)
+            def inner(*args, **kwargs):
+                with self.phase(name, key=key) as p:
+                    return p.sync(fn(*args, **kwargs))
+            return inner
+        return deco
+
+    def observe(self, name: str, seconds: float, *, mode: str = EXECUTE,
+                calls: int = 1, **meta) -> None:
+        """Record an externally measured duration. ``calls`` spreads the
+        duration over that many invocations in the aggregate (one
+        histogram observation either way — it is one measured region)."""
+        hbm = device_memory_stats() if self.sample_hbm else None
+        self._record(name, mode, seconds, calls=calls, meta=meta, hbm=hbm)
+
+    def _record(self, name: str, mode: str, seconds: float, *,
+                calls: int = 1, meta: dict | None = None,
+                hbm: dict | None = None) -> None:
+        self._hist.labels(name, mode).observe(seconds)
+        with self._lock:
+            self._stats.setdefault((name, mode), _Stat()).add(seconds, calls)
+            self._records.append(PhaseRecord(
+                name=name, mode=mode, seconds=seconds, ts=time.time(),
+                meta=dict(meta or {}), hbm=hbm))
+            if hbm:
+                self._last_hbm = hbm
+
+    def stat(self, name: str, mode: str) -> dict | None:
+        with self._lock:
+            s = self._stats.get((name, mode))
+            return s.as_dict() if s else None
+
+    def records(self, n: int = 50) -> list[dict]:
+        with self._lock:
+            recent = list(self._records)[-n:]
+        return [
+            {
+                "phase": r.name, "mode": r.mode,
+                "seconds": round(r.seconds, 6), "ts": r.ts,
+                **({"meta": r.meta} if r.meta else {}),
+                **({"hbm": r.hbm} if r.hbm else {}),
+            }
+            for r in recent
+        ]
+
+    def summary(self) -> dict:
+        """Per-phase compile/execute aggregates + latest HBM sample —
+        the ``GET /debug/profile`` payload."""
+        with self._lock:
+            stats = {k: s.as_dict() for k, s in self._stats.items()}
+            hbm = dict(self._last_hbm) if self._last_hbm else None
+        phases: dict[str, dict] = {}
+        for (name, mode), d in sorted(stats.items()):
+            phases.setdefault(name, {})[mode] = d
+        for name, modes in phases.items():
+            comp = modes.get(COMPILE)
+            execu = modes.get(EXECUTE)
+            if comp and execu:
+                # what the first call paid beyond a steady-state run —
+                # the trace+compile overhead this profiler exists to expose
+                modes["compile_overhead_seconds"] = round(
+                    max(0.0, comp["last_seconds"] - execu["mean_seconds"]), 6)
+        return {"metric": self.metric, "phases": phases, "hbm": hbm}
+
+    def reset(self) -> None:
+        """Drop first-call marks, aggregates and records (tests)."""
+        with self._lock:
+            self._seen.clear()
+            self._stats.clear()
+            self._records.clear()
+            self._last_hbm = None
+
+
+def render_profile(summary: dict) -> str:
+    """The ``tpu-kubernetes get profile`` table for a summary dict."""
+    phases = summary.get("phases") or {}
+    lines = [
+        f"{'PHASE':<12} {'MODE':<8} {'CALLS':>6} {'TOTAL_S':>9} "
+        f"{'MEAN_S':>9} {'LAST_S':>9}"
+    ]
+    if not phases:
+        lines.append("(no phases recorded yet)")
+    for name in sorted(phases):
+        modes = phases[name]
+        for mode in (COMPILE, EXECUTE):
+            d = modes.get(mode)
+            if not d:
+                continue
+            lines.append(
+                f"{name:<12} {mode:<8} {d['count']:>6} "
+                f"{d['total_seconds']:>9.4f} {d['mean_seconds']:>9.4f} "
+                f"{d['last_seconds']:>9.4f}")
+        overhead = modes.get("compile_overhead_seconds")
+        if overhead is not None:
+            lines.append(
+                f"{name:<12} {'— compile overhead:':<25}"
+                f"{overhead:>10.4f}s")
+    hbm = summary.get("hbm")
+    if hbm:
+        parts = [f"{k}={v / 2**20:.1f}MiB" for k, v in sorted(hbm.items())]
+        lines.append("hbm: " + " ".join(parts))
+    return "\n".join(lines) + "\n"
+
+
+def fetch_profile(target: str, timeout: float = 5.0) -> dict:
+    """GET ``/debug/profile`` from ``host:port`` (scheme/path optional,
+    mirroring the aggregate scraper's target normalization)."""
+    t = target.strip()
+    if "//" not in t:
+        t = "http://" + t
+    if not t.rstrip("/").endswith("/debug/profile"):
+        t = t.rstrip("/") + "/debug/profile"
+    with urllib.request.urlopen(t, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8", "replace"))

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from tpu_kubernetes.obs import REGISTRY
+
 try:  # pltpu only imports on TPU-capable installs; interpret mode needs pl only
     from jax.experimental.pallas import tpu as pltpu
 
@@ -34,6 +36,17 @@ try:  # pltpu only imports on TPU-capable installs; interpret mode needs pl only
 except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
+
+# trace-time dispatch accounting: the wrapper body runs once per jit
+# TRACE and never in the compiled program, so incrementing here is zero
+# steady-state overhead — and "which lane got traced, how often" is how
+# /metrics reveals a silent reference-path fallback on real hardware
+OPS_TRACED = REGISTRY.counter(
+    "tpu_ops_traced_total",
+    "kernel wrapper traces by op and dispatch lane (counts jit traces, "
+    "not executions — wrapper bodies only run at trace time)",
+    labelnames=("op", "path"),
+)
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
@@ -397,6 +410,11 @@ def flash_attention(
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if use_pallas is None:
         use_pallas = _on_tpu()
+    OPS_TRACED.labels(
+        "flash_attention",
+        "pallas" if use_pallas else ("interpret" if interpret
+                                     else "reference"),
+    ).inc()
     if not (use_pallas or interpret):
         return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
 

@@ -49,7 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from tpu_kubernetes.ops.flash_attention import _fit_block, _on_tpu
+from tpu_kubernetes.ops.flash_attention import OPS_TRACED, _fit_block, _on_tpu
 
 try:  # the grid spec + scratch spaces here genuinely need pltpu (unlike
     # flash_attention, whose specs degrade to plain BlockSpec); without it
@@ -384,7 +384,13 @@ def grouped_matmul(
         )
     if use_pallas is None:
         use_pallas = _on_tpu()
-    if pltpu is None or not (use_pallas or interpret):
+    kernel = pltpu is not None and (use_pallas or interpret)
+    OPS_TRACED.labels(
+        "grouped_matmul",
+        ("pallas" if use_pallas else "interpret") if kernel
+        else "reference",
+    ).inc()
+    if not kernel:
         if _on_tpu():
             # the reference is O(E·M·K·N) — fine for tests, a silent
             # E× throughput tax if it engages on real hardware. Warn

@@ -106,6 +106,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tpu_kubernetes.obs import REGISTRY, events
 from tpu_kubernetes.obs import metrics as obs_metrics
+from tpu_kubernetes.obs.profile import PhaseProfiler
 from tpu_kubernetes.util import log
 from tpu_kubernetes.util.trace import TRACER, span_tree
 
@@ -169,6 +170,16 @@ INFLIGHT = REGISTRY.gauge(
     "tpu_serve_inflight_requests",
     "requests currently inside a handler (the server-side queue depth "
     "a fleet monitor watches — generation serializes on one lock)",
+)
+# device-synced phase attribution (obs/profile.py): prefill / decode /
+# fused-generate device seconds split by mode — "compile" is a program's
+# first call (jit trace + XLA compile ride on it), "execute" is steady
+# state. Summarized at GET /debug/profile, scraped as this histogram.
+PROFILER = PhaseProfiler(
+    metric="tpu_serve_phase_seconds",
+    help="device-synced serving phase seconds (mode=compile is a "
+         "program's first call including trace+compile; mode=execute "
+         "is steady state)",
 )
 
 
@@ -582,10 +593,15 @@ class ServingState:
 
         fn = self._program(max_new, 0.0, 0, 0.0)
         with self._lock:
-            out = fn(
-                self.params, jnp.asarray(padded),
-                rng=jax.random.PRNGKey(0), prompt_lengths=lengths,
-            )
+            with PROFILER.phase(
+                "generate",
+                key=("generate", max_new, 0.0, 0, 0.0, width, b),
+                tracer=TRACER,
+            ) as pg:
+                out = pg.sync(fn(
+                    self.params, jnp.asarray(padded),
+                    rng=jax.random.PRNGKey(0), prompt_lengths=lengths,
+                ))
             tokens = np.asarray(out)
         for i, entry in enumerate(entries):
             entry["tokens"] = tokens[i][:entry["max_new"]].tolist()
@@ -647,11 +663,18 @@ class ServingState:
         ck = self._cached_program(("lookup_chunk", k), _build_chunk)
 
         padded = self._pad_rows([ids], width)
-        with TRACER.phase("prefill", quiet=True):
-            logits, cache = pf(
+        with PROFILER.phase(
+            "prefill", key=("prefill", span), tracer=TRACER,
+        ) as pp:
+            logits, cache = pp.sync(pf(
                 self.params, jnp.asarray(padded),
                 lengths=jnp.asarray([len(ids)], jnp.int32),
-            )
+            ))
+        # the chunk program's first call pays trace+compile; later rounds
+        # accumulate into one steady-state decode observation (finally)
+        chunk_first = PROFILER.mark_first("decode", ("lookup_chunk", k))
+        chunk_s = 0.0
+        chunk_n = 0
         last = int(np.argmax(np.asarray(logits)[0]))
         emitted = [last]
         ctx = list(ids) + [last]
@@ -661,11 +684,18 @@ class ServingState:
             yield [] if done else [last]          # EOS itself is not emitted
             while not done and len(emitted) < max_new:
                 drafts = self._ngram_host(ctx, last)
+                t_ck = time.perf_counter()
                 greedy, cache = ck(
                     self.params, cache,
                     jnp.asarray([last] + drafts, jnp.int32),
                 )
                 g = np.asarray(greedy).tolist()              # k+1 tokens
+                d_ck = time.perf_counter() - t_ck
+                if chunk_first and rounds == 0:
+                    PROFILER.observe("decode", d_ck, mode="compile")
+                else:
+                    chunk_s += d_ck
+                    chunk_n += 1
                 j = 0
                 while j < k and drafts[j] == g[j]:
                     j += 1
@@ -691,6 +721,9 @@ class ServingState:
         finally:
             # finally: a streaming disconnect closes this generator at a
             # yield — the work done must still reach the totals
+            if chunk_n:
+                PROFILER.observe("decode", chunk_s, mode="execute",
+                                 calls=chunk_n)
             with self._spec_lock:
                 self.spec_totals["rounds"] += rounds + 1   # +1: the prefill
                 self.spec_totals["drafted"] += drafted
@@ -779,14 +812,22 @@ class ServingState:
         else:
             fn = self._program(run_max_new, float(temperature), int(top_k),
                                float(top_p))
+            # the program retraces per prompt-width bucket, so width is
+            # part of what identifies "this compile" to the profiler
+            gkey = ("generate", run_max_new, float(temperature), int(top_k),
+                    float(top_p), width, 1)
             with self._locked_phase():
                 with TRACER.phase("batch", quiet=True, mode="solo"):
-                    out = fn(
-                        self.params,
-                        jnp.asarray(self._pad_rows([ids], width)),
-                        rng=jax.random.PRNGKey(int(seed)),
-                        prompt_lengths=jnp.asarray([len(ids)], jnp.int32),
-                    )
+                    with PROFILER.phase(
+                        "generate", key=gkey, tracer=TRACER,
+                    ) as pg:
+                        out = pg.sync(fn(
+                            self.params,
+                            jnp.asarray(self._pad_rows([ids], width)),
+                            rng=jax.random.PRNGKey(int(seed)),
+                            prompt_lengths=jnp.asarray(
+                                [len(ids)], jnp.int32),
+                        ))
                     tokens = np.asarray(out)[0].tolist()
         tokens = tokens[:max_new]              # bucketed run → requested budget
         if self.eos_id is not None and self.eos_id in tokens:
@@ -892,29 +933,59 @@ class ServingState:
         def tokens():
             if self.ready:
                 PROMPT_TOKENS.inc(len(ids))
-            with TRACER.phase("prefill", quiet=True):
-                logits, cache = pf(
+            with PROFILER.phase(
+                "prefill", key=("prefill", span), tracer=TRACER,
+            ) as pp:
+                logits, cache = pp.sync(pf(
                     self.params, jnp.asarray(padded),
                     lengths=jnp.asarray([len(ids)], jnp.int32),
-                )
+                ))
             tok = _sample(
                 logits, first_rng, float(temperature), int(top_k),
                 float(top_p),
             )
-            for i in range(max_new):
-                t = int(np.asarray(tok)[0])
-                if self.eos_id is not None and t == self.eos_id:
-                    if finish is not None:
-                        finish["reason"] = "stop"
-                    return
-                if self.ready:
-                    TOKENS_GENERATED.inc()
-                yield [t]
-                if i + 1 == max_new:
-                    if finish is not None:
-                        finish["reason"] = "length"
-                    return
-                tok, cache = step(self.params, cache, tok, step_rngs[i])
+            # decode attribution: the step program's first call carries
+            # trace+compile and is phased on its own; the remaining steps
+            # accumulate OUTSIDE the yields (consumer pacing must not
+            # count as device time) into one steady-state observation
+            step_key = ("step", float(temperature), int(top_k),
+                        float(top_p))
+            tail_s = 0.0
+            tail_n = 0
+            try:
+                for i in range(max_new):
+                    t = int(np.asarray(tok)[0])
+                    if self.eos_id is not None and t == self.eos_id:
+                        if finish is not None:
+                            finish["reason"] = "stop"
+                        return
+                    if self.ready:
+                        TOKENS_GENERATED.inc()
+                    yield [t]
+                    if i + 1 == max_new:
+                        if finish is not None:
+                            finish["reason"] = "length"
+                        return
+                    if i == 0:
+                        with PROFILER.phase(
+                            "decode", key=step_key, tracer=TRACER,
+                        ) as pd:
+                            tok, cache = step(
+                                self.params, cache, tok, step_rngs[i]
+                            )
+                            pd.sync(tok)
+                    else:
+                        t0 = time.perf_counter()
+                        tok, cache = step(
+                            self.params, cache, tok, step_rngs[i]
+                        )
+                        jax.block_until_ready(tok)
+                        tail_s += time.perf_counter() - t0
+                        tail_n += 1
+            finally:
+                if tail_n:
+                    PROFILER.observe("decode", tail_s, mode="execute",
+                                     calls=tail_n)
 
         with self._locked_phase():
             yield from self._safe_deltas(tokens())
@@ -927,7 +998,7 @@ class _Handler(BaseHTTPRequestHandler):
     # the bounded endpoint-label vocabulary: anything else is "other" so a
     # path-scanning client can't mint unbounded label cardinality
     _ENDPOINTS = frozenset({
-        "/healthz", "/metrics", "/v1/models",
+        "/healthz", "/metrics", "/v1/models", "/debug/profile",
         "/v1/completions", "/v1/chat/completions",
     })
 
@@ -1020,6 +1091,11 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return None
+        if self.path == "/debug/profile":
+            # compile-vs-execute phase attribution + latest HBM sample
+            # (obs/profile.py summary) — `tpu-kubernetes get profile`
+            # renders this payload
+            return self._json(200, PROFILER.summary())
         if self.path.startswith("/debug/trace/"):
             # the span tree of one request/run, looked up by the id the
             # response's X-Request-Id header carried

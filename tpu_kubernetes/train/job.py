@@ -171,16 +171,30 @@ def main() -> None:
         mean = total / eval_batches
         log(f"eval step={at_step} loss={mean:.4f} ppl={math.exp(min(mean, 30)):.2f}")
 
-    from tpu_kubernetes.train.trainer import FIRST_STEP_SECONDS, observe_steps
+    from tpu_kubernetes.obs.profile import device_memory_stats
+    from tpu_kubernetes.train.trainer import (
+        FIRST_STEP_SECONDS,
+        observe_first_step,
+        observe_steps,
+    )
 
     first_step_done = False
     t_last = time.time()
     for i in range(start_step, steps):
+        t_call = 0.0 if first_step_done else time.time()
         state, loss = step_fn(state, next(batches))
         if not first_step_done:
             jax.block_until_ready(loss)
             first_step_s = time.time() - t_start
             FIRST_STEP_SECONDS.set(first_step_s)
+            # the step call alone (trace+compile+run) — the compile-mode
+            # phase the execute windows below compare against
+            observe_first_step(time.time() - t_call)
+            hbm = device_memory_stats()
+            if hbm and "peak_bytes_in_use" in hbm:
+                log(f"hbm peak={hbm['peak_bytes_in_use'] / 2**30:.2f}GiB"
+                    + (f" limit={hbm['bytes_limit'] / 2**30:.2f}GiB"
+                       if "bytes_limit" in hbm else ""))
             log(f"FIRST TRAIN STEP at +{first_step_s:.1f}s "
                 f"loss={float(loss):.4f}")   # the north-star latency marker
             first_step_done = True

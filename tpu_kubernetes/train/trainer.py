@@ -19,6 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from tpu_kubernetes.models import ModelConfig, init_params, logical_axes, loss_fn
 from tpu_kubernetes.obs import REGISTRY
+from tpu_kubernetes.obs.profile import PhaseProfiler
 from tpu_kubernetes.parallel import batch_sharding, param_shardings
 
 # -- training telemetry (obs/metrics.py) -------------------------------------
@@ -45,6 +46,28 @@ FIRST_STEP_SECONDS = REGISTRY.gauge(
     "tpu_train_first_step_seconds",
     "job start to first completed train step (the north-star latency)",
 )
+# compile-vs-execute attribution (obs/profile.py): the first step call
+# pays jit trace + XLA compile; steady-state step time flows in window-
+# grained through observe_steps — the split is what turns "step time
+# moved" into "the compile got slower" vs "the step got slower"
+PROFILER = PhaseProfiler(
+    metric="tpu_train_phase_seconds",
+    help="device-synced training phase seconds (mode=compile is the "
+         "first step including trace+compile; mode=execute is steady "
+         "state)",
+)
+COMPILE_OVERHEAD_SECONDS = REGISTRY.gauge(
+    "tpu_train_compile_overhead_seconds",
+    "what the first step paid beyond a steady-state step (trace + XLA "
+    "compile); updated once steady-state windows exist",
+)
+
+
+def observe_first_step(seconds: float) -> None:
+    """The first step CALL, device-synced by the caller — the compile-
+    mode phase. (FIRST_STEP_SECONDS is create→first-step, a superset
+    that also counts init/mesh/data; this is the step itself.)"""
+    PROFILER.observe("step", seconds, mode="compile")
 
 
 def observe_steps(window_seconds: float, n_steps: int, tokens: int,
@@ -63,6 +86,12 @@ def observe_steps(window_seconds: float, n_steps: int, tokens: int,
     TOKENS_PER_SECOND.set(tokens / window_seconds)
     if loss is not None:
         TRAIN_LOSS.set(float(loss))
+    PROFILER.observe("step", window_seconds, mode="execute", calls=n_steps)
+    comp = PROFILER.stat("step", "compile")
+    if comp:
+        COMPILE_OVERHEAD_SECONDS.set(
+            max(0.0, comp["last_seconds"] - per_step)
+        )
 
 
 @dataclass(frozen=True)
