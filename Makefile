@@ -3,7 +3,7 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast obs-check monitor-check perf-check bench dryrun native dist dist-offline clean
+.PHONY: test test-fast obs-check monitor-check perf-check serve-identity-check bench dryrun native dist dist-offline clean
 
 test:
 	python -m pytest tests/ -q
@@ -39,11 +39,20 @@ monitor-check:
 # generous — cross-machine wall-clock varies, and this gate exists to
 # catch catastrophic regressions (a lost jit, an accidental O(n^2)), not
 # single-digit drift; same-machine drift is what the default 1.5x
-# threshold against benchmarks/history/ is for.
+# threshold against benchmarks/history/ is for. --require-baseline makes
+# a silently-deleted bench (a baselined metric absent from the run) fail
+# the gate instead of merely printing.
 perf-check:
 	JAX_PLATFORMS=cpu python -m tpu_kubernetes bench run --suite all \
 	  --check --baseline benchmarks/baseline.jsonl --threshold 5.0 \
-	  --n 3 --warmup 2
+	  --n 3 --warmup 2 --require-baseline
+
+# Quick pre-commit identity gate for the serve hot path: only the greedy
+# token-identity tests (warm-prefix vs cold prefill, early-exit vs
+# run-to-max decode, batched vs solo — fp32 and int8 KV cache).
+serve-identity-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py \
+	  tests/test_serve_prefix.py -q -m "not slow" -k identity
 
 bench:
 	python bench.py

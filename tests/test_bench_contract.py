@@ -49,17 +49,67 @@ def test_section_child_probe_failure_degrades_in_band():
     assert "Traceback" not in r.stderr
 
 
-def test_probe_backend_raises_backend_unavailable(monkeypatch):
+def _load_bench():
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
         "bench_module", REPO / "bench.py")
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
+    return bench
 
+
+def test_probe_backend_raises_backend_unavailable(monkeypatch):
+    bench = _load_bench()
     monkeypatch.setenv("JAX_PLATFORMS", "no-such-platform")
     with pytest.raises(bench.BackendUnavailable):
         bench.probe_backend(max_tries=1, probe_timeout_s=60.0)
     # the in-band class is a RuntimeError subtype, so existing callers
     # that caught RuntimeError keep working
     assert issubclass(bench.BackendUnavailable, RuntimeError)
+
+
+def test_run_section_child_midrun_crash_degrades_in_band(
+        tmp_path, monkeypatch):
+    """A child that dies MID-RUN (partial stdout, nonzero rc) must come
+    back as an in-band error dict — never an exception in the parent."""
+    bench = _load_bench()
+    # stand in for the interpreter: emit partial stdout (a half-written
+    # result), a stderr tail, then crash
+    fake = tmp_path / "crashing-child.sh"
+    fake.write_text(
+        "#!/bin/sh\n"
+        'printf \'{"partial": \'\n'
+        "echo 'RuntimeError: chip fell over mid-section' >&2\n"
+        "exit 2\n"
+    )
+    fake.chmod(0o755)
+    monkeypatch.setattr(sys, "executable", str(fake))
+    result = bench.run_section_child("dense", budget=60.0)
+    assert result == {
+        "error": "RuntimeError: rc=2: RuntimeError: chip fell over "
+                 "mid-section"
+    }
+
+
+def test_main_emits_one_line_exit_zero_when_extras_section_crashes(
+        monkeypatch, capsys):
+    """Parent contract under a mid-run extras crash: dense merges, the
+    crashed section degrades to its error dict, and EXACTLY one JSON
+    line reaches stdout (main returns normally → exit 0)."""
+    bench = _load_bench()
+
+    def fake_child(section, budget):
+        if section == "dense":
+            return {"mfu": 0.42, "tokens_per_sec": 1000.0}
+        return {"error": "RuntimeError: rc=2: chip fell over mid-section"}
+
+    monkeypatch.setattr(bench, "run_section_child", fake_child)
+    bench.main()
+    out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert len(out) == 1
+    payload = json.loads(out[0])
+    assert payload["metric"] == "mfu"
+    assert payload["value"] == 0.42
+    assert payload["moe"]["error"].startswith("RuntimeError: rc=2")
+    assert payload["decode"]["error"].startswith("RuntimeError: rc=2")

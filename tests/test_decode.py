@@ -309,6 +309,106 @@ class TestKVQuant:
             np.testing.assert_array_equal(np.asarray(got[i]), np.asarray(ref[0]))
 
 
+class TestPrefixResumeAndEarlyExit:
+    """ISSUE 4 identity guarantees: warm-prefix prefill
+    (prefill_resume) and segmented done-masked decode (decode_segment)
+    must be greedy token-identical to the cold / fused paths — full
+    precision AND int8 KV cache. `make serve-identity-check` runs these
+    (with the server-level suite) via ``-k identity``."""
+
+    @pytest.mark.parametrize("kv_quant", [False, True])
+    def test_warm_resume_identity_with_cold_prefill(self, params, kv_quant):
+        """A resumed cache must share the cold ragged prefill's exact
+        geometry (length / prompt_slots / prompt_lengths — so every
+        downstream decode program is the same compile) and its greedy
+        continuation token-for-token."""
+        from tpu_kubernetes.models import decode_segment, prefill_resume
+
+        n, q, width, new = 24, 16, 32, 6
+        span = width + new
+        ids = jax.random.randint(
+            jax.random.PRNGKey(60), (1, n), 0, CFG.vocab_size, jnp.int32
+        )
+        padded = jnp.pad(ids, ((0, 0), (0, width - n)))
+        cold_logits, cold_cache = prefill(
+            params, padded, CFG, max_seq=span,
+            lengths=jnp.asarray([n], jnp.int32), kv_quant=kv_quant,
+        )
+        # warm: a cached 16-token prefix (uniform cache) + the 8-token
+        # suffix resumed into the SAME width bucket
+        _, base = prefill(
+            params, ids[:, :q], CFG, max_seq=span, kv_quant=kv_quant
+        )
+        suffix = jnp.pad(ids[:, q:], ((0, 0), (0, width - n)))
+        warm_logits, warm_cache = prefill_resume(
+            params, suffix, CFG, base,
+            lengths=jnp.asarray([n - q], jnp.int32),
+        )
+        assert int(warm_cache.length) == int(cold_cache.length) == width
+        assert (int(warm_cache.prompt_slots)
+                == int(cold_cache.prompt_slots) == width)
+        np.testing.assert_array_equal(
+            np.asarray(warm_cache.prompt_lengths),
+            np.asarray(cold_cache.prompt_lengths),
+        )
+        tok_c = jnp.argmax(cold_logits, -1).astype(jnp.int32)
+        tok_w = jnp.argmax(warm_logits, -1).astype(jnp.int32)
+        np.testing.assert_array_equal(np.asarray(tok_c), np.asarray(tok_w))
+        no_done = jnp.zeros((1,), bool)
+        ec, *_ = decode_segment(
+            params, cold_cache, tok_c, no_done, CFG, steps=new - 1
+        )
+        ew, *_ = decode_segment(
+            params, warm_cache, tok_w, no_done, CFG, steps=new - 1
+        )
+        np.testing.assert_array_equal(np.asarray(ec), np.asarray(ew))
+
+    @pytest.mark.parametrize("kv_quant", [False, True])
+    def test_segmented_decode_identity_with_fused_generate(
+            self, params, kv_quant):
+        """prefill + K-step decode_segment calls == the fused generate
+        scan, including EOS done-masking: masked rows emit pad_id but
+        their cache keeps evolving exactly as the fused scan's does."""
+        from tpu_kubernetes.models import decode_segment
+
+        lengths = [5, 8]
+        plen, new = 8, 7
+        padded = jnp.stack([
+            jnp.pad(
+                jax.random.randint(
+                    jax.random.PRNGKey(70 + i), (m,), 0, CFG.vocab_size
+                ),
+                (0, plen - m),
+            )
+            for i, m in enumerate(lengths)
+        ])
+        pl = jnp.asarray(lengths, jnp.int32)
+        free = generate(
+            params, padded, CFG, max_new_tokens=new, prompt_lengths=pl,
+            kv_quant=kv_quant,
+        )
+        eos = int(np.asarray(free)[0, 2])   # row 0 stops early
+        ref = generate(
+            params, padded, CFG, max_new_tokens=new, prompt_lengths=pl,
+            kv_quant=kv_quant, eos_id=eos, pad_id=0,
+        )
+        logits, cache = prefill(
+            params, padded, CFG, max_seq=plen + new, lengths=pl,
+            kv_quant=kv_quant,
+        )
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        pieces = [np.asarray(tok)[:, None]]
+        done = tok == eos
+        for steps in (3, 3):                # two 3-step segments = new-1
+            toks, tok, done, cache = decode_segment(
+                params, cache, tok, done, CFG, steps=steps,
+                eos_id=eos, pad_id=0,
+            )
+            pieces.append(np.asarray(toks))
+        got = np.concatenate(pieces, axis=1)
+        np.testing.assert_array_equal(got, np.asarray(ref))
+
+
 def test_ragged_decode_chunk_matches_sequential_steps(params):
     """decode_chunk over a ragged (right-padded) batch == the same c
     tokens fed through sequential decode_steps — the verification

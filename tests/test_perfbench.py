@@ -42,6 +42,8 @@ def test_registry_covers_every_suite():
     assert "ops.rms_norm" in BENCHES
     assert "serve.prefill" in BENCHES
     assert "serve.decode_step" in BENCHES
+    assert "serve.prefill_warm" in BENCHES
+    assert "serve.decode_early_exit" in BENCHES
     assert "train.step" in BENCHES
 
 
@@ -180,10 +182,10 @@ def test_make_entry_shape():
 
 def test_bench_run_cli_first_run_then_injected_regression(
         tmp_path, monkeypatch, capsys):
-    # train.step (~ms on CPU) rather than a ~30µs op: at microsecond scale
-    # run-to-run noise can swamp a 2x injection, at millisecond scale the
-    # observed drift is single-digit percent — the gate must trip on
-    # timing, not luck
+    # train.step (~ms on CPU) rather than a ~30µs op, and a 10x injection
+    # rather than 2x: under full-suite load the un-injected runs drift by
+    # 2-3x, so the synthetic slowdown must sit far above machine noise —
+    # the gate must trip on timing, not luck
     from tpu_kubernetes.cli.main import main
 
     hist = str(tmp_path / "history")
@@ -194,8 +196,8 @@ def test_bench_run_cli_first_run_then_injected_regression(
     assert len(load_history(history_path(hist, "train"))) == 1
     # steady second run against the rolling baseline → still ok
     assert main(argv) == 0
-    # a synthetic 2x slowdown must make --check exit nonzero
-    monkeypatch.setenv("PERFBENCH_SLOWDOWN", "train.step:2.0")
+    # a synthetic 10x slowdown must make --check exit nonzero
+    monkeypatch.setenv("PERFBENCH_SLOWDOWN", "train.step:10.0")
     rc = main(argv)
     assert rc == EXIT_REGRESSION != 0
     out = capsys.readouterr().out
@@ -234,6 +236,55 @@ def test_bench_run_cli_explicit_baseline_file(tmp_path, capsys):
                    "--check", "--baseline", str(baseline),
                    "--threshold", "1e-9"])
     assert bad_rc == EXIT_REGRESSION
+
+
+def test_bench_run_cli_require_baseline_flags_missing_metric(
+        tmp_path, capsys):
+    # a baselined metric absent from the run (a silently-deleted bench)
+    # is reported-but-ok by default; --require-baseline makes it exit 3
+    from tpu_kubernetes.cli.main import main
+
+    baseline = tmp_path / "baseline.jsonl"
+    append_history(baseline, _entry(
+        {"ops.rms_norm": 1.0, "ops.retired_bench": 1.0}))
+    hist = str(tmp_path / "h")
+    argv = ["bench", "run", "--suite", "ops", "--only", "rms_norm",
+            "--n", "1", "--warmup", "1", "--history-dir", hist,
+            "--check", "--baseline", str(baseline), "--threshold", "1e9"]
+    assert main(argv) == 0                       # default: print, don't fail
+    capsys.readouterr()
+    rc = main(argv + ["--require-baseline"])
+    assert rc == EXIT_REGRESSION
+    out = capsys.readouterr().out
+    assert "MISSING" in out and "ops.retired_bench" in out
+
+
+def test_bench_run_cli_require_baseline_passes_when_covered(tmp_path):
+    # every baselined metric present in the run → strict mode stays 0
+    from tpu_kubernetes.cli.main import main
+
+    baseline = tmp_path / "baseline.jsonl"
+    append_history(baseline, _entry({"ops.rms_norm": 1.0}))
+    assert main(["bench", "run", "--suite", "ops", "--only", "rms_norm",
+                 "--n", "1", "--warmup", "1",
+                 "--history-dir", str(tmp_path / "h"),
+                 "--check", "--baseline", str(baseline),
+                 "--threshold", "1e9", "--require-baseline"]) == 0
+
+
+def test_bench_run_cli_require_baseline_scoped_to_run_suites(tmp_path):
+    # baselined metrics from suites NOT being run (train.*) must not
+    # trip the ops-only strict gate — scoping is per suite run
+    from tpu_kubernetes.cli.main import main
+
+    baseline = tmp_path / "baseline.jsonl"
+    append_history(baseline, _entry({"ops.rms_norm": 1.0}))
+    append_history(baseline, _entry({"train.step": 1.0}, suite="train"))
+    assert main(["bench", "run", "--suite", "ops", "--only", "rms_norm",
+                 "--n", "1", "--warmup", "1",
+                 "--history-dir", str(tmp_path / "h"),
+                 "--check", "--baseline", str(baseline),
+                 "--threshold", "1e9", "--require-baseline"]) == 0
 
 
 def test_bench_run_cli_no_matching_benches(tmp_path):

@@ -1,0 +1,99 @@
+"""Unit tests for serve/prefix_cache.py — the bounded LRU of KV prompt
+prefixes. Pure container semantics (no jax): longest-prefix matching,
+byte-accurate sizing, LRU eviction under the cap, subsumption on insert,
+and the bytes callback the server points at its gauge."""
+
+import numpy as np
+
+from tpu_kubernetes.serve.prefix_cache import (
+    PrefixCache,
+    PrefixEntry,
+    _common_prefix_len,
+)
+
+
+def _arrays(n_tokens: int, itembytes_per_token: int = 8):
+    """A fake per-token segment: n_tokens positions of f64 (8 B each)."""
+    return {"k": np.zeros((n_tokens,), np.float64)}
+
+
+def test_common_prefix_len():
+    assert _common_prefix_len((1, 2, 3), (1, 2, 4)) == 2
+    assert _common_prefix_len((1, 2), (1, 2, 3)) == 2
+    assert _common_prefix_len((9,), (1,)) == 0
+    assert _common_prefix_len((), (1, 2)) == 0
+
+
+def test_entry_nbytes_is_byte_accurate():
+    e = PrefixEntry(ids=(1, 2, 3), arrays={
+        "k": np.zeros((2, 3), np.float32),   # 24 B
+        "v": np.zeros((6,), np.int8),        # 6 B
+        "scale": None,                       # ignored
+    })
+    assert e.nbytes == 24 + 6
+
+
+def test_lookup_longest_match_and_miss():
+    pc = PrefixCache(max_bytes=1 << 20)
+    pc.insert([1, 2, 3, 4], _arrays(4))
+    pc.insert([1, 2, 9, 9, 9, 9], _arrays(6))
+    q, entry = pc.lookup([1, 2, 3, 4, 5, 6])
+    assert q == 4 and entry.ids == (1, 2, 3, 4)
+    q, entry = pc.lookup([1, 2, 9, 7])
+    assert q == 3 and entry.ids == (1, 2, 9, 9, 9, 9)  # partial match
+    q, entry = pc.lookup([8, 8])
+    assert q == 0 and entry is None
+
+
+def test_insert_covered_refreshes_instead_of_duplicating():
+    pc = PrefixCache(max_bytes=1 << 20)
+    assert pc.insert([1, 2, 3, 4], _arrays(4)) is True
+    # a strict prefix of a stored entry adds nothing
+    assert pc.insert([1, 2], _arrays(2)) is False
+    assert len(pc) == 1
+    # an extension REPLACES the shorter stored segment
+    assert pc.insert([1, 2, 3, 4, 5, 6], _arrays(6)) is True
+    assert len(pc) == 1
+    q, entry = pc.lookup([1, 2, 3, 4, 5, 6, 7])
+    assert q == 6 and len(entry.ids) == 6
+
+
+def test_lru_eviction_under_byte_cap():
+    # each segment = 10 tokens × 8 B = 80 B; cap fits two
+    pc = PrefixCache(max_bytes=200)
+    pc.insert(list(range(100, 110)), _arrays(10))
+    pc.insert(list(range(200, 210)), _arrays(10))
+    assert len(pc) == 2 and pc.bytes == 160
+    # touch the FIRST entry so the second becomes least-recently-used
+    q, _ = pc.lookup(list(range(100, 110)))
+    assert q == 10
+    pc.insert(list(range(300, 310)), _arrays(10))
+    assert len(pc) == 2 and pc.bytes <= pc.max_bytes
+    assert pc.lookup(list(range(200, 210)))[1] is None   # evicted
+    assert pc.lookup(list(range(100, 110)))[0] == 10     # survived
+
+
+def test_oversized_segment_is_refused():
+    pc = PrefixCache(max_bytes=64)
+    assert pc.insert(list(range(100)), _arrays(100)) is False
+    assert len(pc) == 0 and pc.bytes == 0
+
+
+def test_on_bytes_callback_tracks_total():
+    seen = []
+    pc = PrefixCache(max_bytes=200, on_bytes=seen.append)
+    pc.insert([1] * 10, _arrays(10))
+    pc.insert([2] * 10, _arrays(10))
+    pc.insert([3] * 10, _arrays(10))     # evicts the [1]* entry
+    assert seen == [80, 160, 160]
+    assert pc.bytes == 160
+
+
+def test_stats_payload():
+    pc = PrefixCache(max_bytes=1024, sig=("llama-test", "float32", False))
+    pc.insert([5] * 8, _arrays(8))
+    s = pc.stats()
+    assert s == {
+        "entries": 1, "bytes": 64, "max_bytes": 1024,
+        "sig": ["llama-test", "float32", False],
+    }

@@ -1,0 +1,265 @@
+"""Serve hot-path tests: prefix KV-cache reuse and early-exit decode.
+
+Token identity is the contract — a warm-prefix prefill and an
+early-exiting decode must produce EXACTLY the tokens the cold,
+run-to-max path produces (fp32 and int8 KV cache). Alongside identity:
+the prefix-cache observability surface (hit/partial/miss counters, the
+bytes gauge, /healthz stats, LRU eviction under the byte cap) and the
+_Batcher taint/requeue regressions (a failing fits() must fail the
+round out loud, and overflow entries must requeue at the FRONT in
+arrival order)."""
+
+import http.client
+import json
+import threading
+
+import pytest
+
+from tpu_kubernetes.serve.server import (
+    BATCH_TAINT,
+    PREFIX_CACHE_BYTES,
+    PREFIX_CACHE_TOTAL,
+    ServingState,
+    _Batcher,
+    make_server,
+)
+
+ENV = {
+    "SERVE_MODEL": "llama-test",
+    "SERVE_MAX_NEW": "8",
+    "SERVE_DTYPE": "float32",    # bf16 ties can break exact-id comparisons
+}
+
+# ≥ MIN_PREFIX_TOKENS chars so completions are insertable; long enough
+# that the matched prefix floors to a useful power of two
+PROMPT = "the quick brown fox jumps over the lazy dog"
+
+
+def _state(**extra) -> ServingState:
+    st = ServingState(dict(ENV, **extra))
+    st.warm()
+    return st
+
+
+@pytest.fixture(scope="module")
+def cold_state():
+    """No prefix cache, early exit DISABLED — the pure run-to-max
+    reference every identity test compares against."""
+    return _state(SERVE_EARLY_EXIT_STEPS="0")
+
+
+@pytest.fixture(scope="module")
+def warm_state():
+    """Prefix cache on, default early-exit interval — the hot path."""
+    return _state(SERVE_PREFIX_CACHE_MB="8")
+
+
+# ---------------------------------------------------------------------------
+# token identity: warm prefix + early exit vs the cold run-to-max path
+# ---------------------------------------------------------------------------
+
+
+def test_warm_prefix_identity_with_cold_prefill(cold_state, warm_state):
+    """Cold fill, exact re-ask (hit), and a diverging extension
+    (partial) must all match the cache-free server token-for-token."""
+    hits = PREFIX_CACHE_TOTAL.labels("hit")
+    cold = cold_state.complete(PROMPT, max_new_tokens=8)
+
+    first = warm_state.complete(PROMPT, max_new_tokens=8)   # cold + insert
+    assert first["text"] == cold["text"]
+    assert warm_state.prefix_cache.stats()["entries"] >= 1
+
+    before = hits.value
+    again = warm_state.complete(PROMPT, max_new_tokens=8)   # full hit
+    assert again["text"] == cold["text"]
+    assert hits.value == before + 1
+
+    ext = PROMPT + " and never looks back"
+    assert (warm_state.complete(ext, max_new_tokens=8)["text"]
+            == cold_state.complete(ext, max_new_tokens=8)["text"])
+
+
+def test_warm_prefix_identity_int8_kv_quant():
+    """Same identity contract with the quantized (int8 + scales) KV
+    cache: resume restores k/v AND the per-slot scales."""
+    kv_cold = _state(SERVE_KV_QUANT="1", SERVE_EARLY_EXIT_STEPS="0")
+    kv_warm = _state(SERVE_KV_QUANT="1", SERVE_PREFIX_CACHE_MB="8")
+    for prompt in (PROMPT, PROMPT, PROMPT + " again and again"):
+        assert (kv_warm.complete(prompt, max_new_tokens=8)["text"]
+                == kv_cold.complete(prompt, max_new_tokens=8)["text"])
+    assert kv_warm.prefix_cache.stats()["sig"][2] is True
+
+
+def test_early_exit_identity_with_run_to_max(cold_state):
+    """A tight liveness interval (K=2, many host checks) must emit the
+    same tokens as the disabled path (one segment to the bucketed max)
+    at every budget, including budgets below and at the bucket — and
+    short budgets must actually SKIP scan steps (the saved counter)."""
+    from tpu_kubernetes.serve.server import DECODE_STEPS_SAVED
+
+    k2 = _state(SERVE_EARLY_EXIT_STEPS="2")
+    s0 = DECODE_STEPS_SAVED.value
+    for max_new in (1, 3, 8):
+        ref = cold_state.complete(PROMPT, max_new_tokens=max_new)
+        out = k2.complete(PROMPT, max_new_tokens=max_new)
+        assert out["text"] == ref["text"]
+        assert out["tokens"] == ref["tokens"]
+    # budget 3 in a run bucket of 8: liveness dies after the first K=2
+    # segment — the remaining steps of the bucket are never scanned
+    assert DECODE_STEPS_SAVED.value > s0
+
+
+# ---------------------------------------------------------------------------
+# observability: counters, gauge, /healthz stats, LRU eviction under cap
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_cache_counters_label_hit_partial_miss(warm_state):
+    misses = PREFIX_CACHE_TOTAL.labels("miss")
+    partials = PREFIX_CACHE_TOTAL.labels("partial")
+    m0, p0 = misses.value, partials.value
+    warm_state.complete("completely unrelated prompt text", max_new_tokens=2)
+    assert misses.value == m0 + 1
+    # the unrelated prompt is now cached; a diverging sibling matches
+    # only its shared prefix → partial
+    warm_state.complete("completely unrelated prompt but different tail",
+                        max_new_tokens=2)
+    assert partials.value == p0 + 1
+
+
+def test_lru_eviction_keeps_bytes_under_cap_and_gauge_tracks():
+    """A tiny cap (0.05 MB ≈ two 48-token fp32 segments) forces LRU
+    eviction; the bytes gauge must track the store exactly and the
+    oldest entry must be the one dropped."""
+    st = _state(SERVE_PREFIX_CACHE_MB="0.05")
+    # distinct FIRST characters — no shared prefix, so an evicted
+    # prompt's lookup cannot partial-match a resident sibling
+    prompts = [f"{i} eviction probe padded out to fill its own bucket"
+               for i in range(4)]
+    for p in prompts:
+        st.complete(p, max_new_tokens=2)
+    stats = st.prefix_cache.stats()
+    assert 1 <= stats["entries"] < 4          # eviction actually happened
+    assert stats["bytes"] <= stats["max_bytes"]
+    assert PREFIX_CACHE_BYTES.value == stats["bytes"]
+    # strict LRU: the first prompt (never touched again) was evicted,
+    # the last one inserted is still resident
+    assert st.prefix_cache.lookup(st.encode(prompts[0]))[1] is None
+    q, entry = st.prefix_cache.lookup(st.encode(prompts[-1]))
+    assert entry is not None and q == len(entry.ids)
+
+
+@pytest.fixture(scope="module")
+def prefix_server():
+    srv = make_server(dict(
+        ENV, SERVER_HOST="127.0.0.1", SERVER_PORT="0",
+        SERVE_PREFIX_CACHE_MB="8",
+    ))
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv
+    srv.shutdown()
+
+
+def _request(server, method, path, body=None):
+    host, port = server.server_address[:2]
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request(
+        method, path,
+        body=None if body is None else json.dumps(body),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def test_http_surfaces_prefix_metrics_and_healthz_stats(prefix_server):
+    req = {"prompt": PROMPT, "max_new_tokens": 4}
+    for _ in range(2):                       # miss + insert, then hit
+        status, body = _request(prefix_server, "POST", "/v1/completions", req)
+        assert status == 200 and json.loads(body)["text"]
+
+    status, body = _request(prefix_server, "GET", "/metrics")
+    text = body.decode()
+    assert status == 200
+    assert "# TYPE tpu_serve_prefix_cache_total counter" in text
+    assert 'tpu_serve_prefix_cache_total{result="hit"}' in text
+    assert "# TYPE tpu_serve_prefix_cached_tokens histogram" in text
+    assert "# TYPE tpu_serve_prefix_cache_bytes gauge" in text
+    assert "# TYPE tpu_serve_decode_steps_saved_total counter" in text
+    assert "# TYPE tpu_serve_batch_taint_total counter" in text
+
+    status, body = _request(prefix_server, "GET", "/healthz")
+    health = json.loads(body)
+    assert status == 200
+    pc = health["prefix_cache"]
+    assert pc["entries"] >= 1
+    assert 0 < pc["bytes"] <= pc["max_bytes"]
+    assert pc["sig"] == ["llama-test", "float32", False]
+
+
+# ---------------------------------------------------------------------------
+# _Batcher regressions: taint on selection failure, requeue ordering
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_taint_fails_round_in_band_and_counts():
+    """A raising fits() must taint the whole round: every entry gets
+    the error (no hung submitters), dispatched still fires, and the
+    taint counter increments — the dispatcher itself survives."""
+    t0 = BATCH_TAINT.value
+
+    def bad_fits(selected, entry):
+        raise RuntimeError("fits exploded")
+
+    b = _Batcher(lambda entries: None, max_batch=4, window_ms=1,
+                 fits=bad_fits)
+    entries = [b.enqueue([i], 1) for i in range(3)]
+    for e in entries:
+        assert e["event"].wait(10)
+        assert e["dispatched"].is_set()
+        with pytest.raises(RuntimeError, match="fits exploded"):
+            _Batcher.result(e)
+    assert BATCH_TAINT.value >= t0 + 1
+
+
+def test_batcher_requeues_overflow_at_front_in_arrival_order():
+    """fits() limiting every batch to a single row must still serve all
+    entries in arrival order: the unselected rest goes back to the
+    FRONT of the queue, ahead of entries enqueued mid-flight."""
+    order = []
+    gate = threading.Event()
+
+    def run_batch(entries):
+        order.append([e["ids"][0] for e in entries])
+        for e in entries:
+            e["tokens"] = []
+        gate.wait(10)
+
+    b = _Batcher(run_batch, max_batch=4, window_ms=1,
+                 fits=lambda selected, entry: not selected)
+    entries = [b.enqueue([i], 1) for i in range(3)]
+    assert entries[0]["dispatched"].wait(10)
+    late = b.enqueue([3], 1)       # arrives while round 1 is in flight
+    gate.set()
+    for e in entries + [late]:
+        assert e["event"].wait(10)
+        assert e["error"] is None
+    assert order == [[0], [1], [2], [3]]
+
+
+def test_batcher_clean_rounds_do_not_taint():
+    """Sanity guard for the counter itself: a healthy dispatch round
+    must not bump the taint counter."""
+    t0 = BATCH_TAINT.value
+
+    def run_batch(entries):
+        for e in entries:
+            e["tokens"] = []
+
+    b = _Batcher(run_batch, max_batch=2, window_ms=1)
+    e = b.enqueue([7], 1)
+    assert e["event"].wait(10) and e["error"] is None
+    assert BATCH_TAINT.value == t0

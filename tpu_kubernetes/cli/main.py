@@ -82,6 +82,9 @@ def build_parser() -> argparse.ArgumentParser:
         "kind",
         choices=["manager", "cluster", "kubeconfig", "runs", "metrics",
                  "profile"],
+        help="profile renders the worker's phase table — cold (prefill) "
+             "vs warm (prefill_warm) prefills split out, so prefix-cache "
+             "savings are read off one row pair",
     )
     get.add_argument(
         "--manager", metavar="NAME",
@@ -161,6 +164,12 @@ def build_parser() -> argparse.ArgumentParser:
              "regression",
     )
     bench.add_argument(
+        "--require-baseline", action="store_true",
+        help="with --check: also exit 3 when a baselined metric is "
+             "missing from the run entirely (the CI gate's guard "
+             "against silently-deleted benches)",
+    )
+    bench.add_argument(
         "--json", dest="as_json", action="store_true",
         help="emit one JSON object instead of the table",
     )
@@ -230,7 +239,7 @@ def main(argv: list[str] | None = None) -> int:
             args.suite, check=args.check, as_json=args.as_json,
             history_dir=args.history_dir, baseline=args.baseline,
             threshold=args.threshold, n=args.n, warmup=args.warmup,
-            only=args.only,
+            only=args.only, require_baseline=args.require_baseline,
         )
 
     if args.command == "get" and args.kind == "profile":

@@ -17,11 +17,13 @@ from tpu_kubernetes.models import moe as _moe
 from tpu_kubernetes.models.decode import (  # noqa: F401
     KVCache,
     decode_chunk,
+    decode_segment,
     decode_step,
     generate,
     init_cache,
     prefill,
     prefill_chunked,
+    prefill_resume,
 )
 from tpu_kubernetes.models.speculative import (  # noqa: F401
     SpecStats,

@@ -379,6 +379,59 @@ def prefill_chunked(
     return logits, cache
 
 
+def prefill_resume(
+    params: dict, tokens: jax.Array, cfg: ModelConfig, cache: KVCache,
+    lengths: jax.Array | None = None,
+) -> tuple[jax.Array, KVCache]:
+    """Warm-prefix prefill: continue a UNIFORM cache already holding
+    ``p = cache.length`` prompt positions with ``tokens`` (batch, c) —
+    the prompt SUFFIX — in one chunked forward. Returns the same
+    contract as :func:`prefill` (last-real-position logits (batch,
+    vocab) f32, filled cache): resuming at p with suffix width c yields
+    a cache metadata-identical to a cold ragged prefill of the whole
+    (p + c)-wide prompt — ``prompt_slots = p + c``, ``prompt_lengths =
+    p + lengths`` — so every downstream decode program is shared with
+    the cold path.
+
+    ``lengths`` ((batch,) int32) marks a RIGHT-padded ragged suffix:
+    row i's real suffix is tokens[i, :lengths[i]]; the pad slots in
+    [p + lengths[i], p + c) hold garbage K/V exactly like cold ragged
+    prefill's pads, masked by the returned metadata. Causality makes
+    the reused prefix slots valid for ANY continuation: K/V at position
+    i depend only on tokens ≤ i, so a cached segment truncated to the
+    shared prefix is bitwise what a fresh prefill of those positions
+    computes (float reduction order aside — the suffix scores against
+    the cache instead of one fused flash attention, the
+    :func:`prefill_chunked` caveat, including its kv_quant divergence:
+    the suffix attends the already-quantized prefix)."""
+    if cache.prompt_lengths is not None:
+        raise ValueError(
+            "prefill_resume needs a uniform cache (prompt_lengths=None): "
+            "a cached prefix is whole real positions [0, length)"
+        )
+    c = tokens.shape[1]
+    if c < 1:
+        raise ValueError("prefill_resume needs at least one suffix token")
+    if c > cache.k.shape[3]:
+        raise ValueError(
+            f"suffix width {c} exceeds cache max_seq {cache.k.shape[3]}"
+        )
+    hidden, new_cache = _decode_chunk_hidden(params, cache, tokens, cfg)
+    if lengths is None:
+        x_last = hidden[:, -1]
+    else:
+        x_last = jnp.take_along_axis(
+            hidden, (lengths - 1)[:, None, None], axis=1
+        )[:, 0]
+        new_cache = new_cache._replace(
+            prompt_lengths=(cache.length + lengths).astype(jnp.int32),
+            prompt_slots=new_cache.length,
+        )
+    x_last = rms_norm(x_last, params["final_norm"], cfg.norm_eps)
+    logits = (x_last @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
+    return logits, new_cache
+
+
 def decode_step(
     params: dict, cache: KVCache, token: jax.Array, cfg: ModelConfig
 ) -> tuple[jax.Array, KVCache]:
@@ -575,3 +628,42 @@ def generate(
     rngs = jax.random.split(rng, max_new_tokens - 1)
     _, rest = jax.lax.scan(step, (cache, first, done), rngs)
     return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+def decode_segment(
+    params: dict, cache: KVCache, token: jax.Array, done: jax.Array,
+    cfg: ModelConfig, steps: int, *, eos_id: int | None = None,
+    pad_id: int = 0,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """``steps`` GREEDY decode steps with per-row done-masking — the
+    early-exit building block: a host loop runs one segment per K
+    steps, reads back ``done``, and stops once every row is finished,
+    so a batch's wall time scales with its longest LIVE row instead of
+    the batch max ``max_new_tokens``.
+
+    token: (batch,) — each row's previously sampled token (the raw
+    sample even for EOS'd rows, matching :func:`generate`'s carry, so
+    the cache evolves identically and emitted tokens are token-identical
+    to the fused scan). done: (batch,) bool — rows whose EOS already
+    appeared; their emitted slots are ``pad_id``, exactly generate's
+    masking. → (emitted (batch, steps) int32, next token (batch,),
+    done (batch,), cache advanced by ``steps``). Budget-based liveness
+    (a row that reached its OWN max_new) is the caller's host-side
+    bookkeeping — budgets never change what a row emits, only when the
+    loop may stop."""
+
+    def step(carry, _):
+        cache, tok, done = carry
+        logits, cache = decode_step(params, cache, tok, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if eos_id is not None:
+            emitted = jnp.where(done, pad_id, nxt)
+            done = done | (nxt == eos_id)
+        else:
+            emitted = nxt
+        return (cache, nxt, done), emitted
+
+    (cache, token, done), toks = jax.lax.scan(
+        step, (cache, token, done), None, length=steps
+    )
+    return toks.T, token, done, cache

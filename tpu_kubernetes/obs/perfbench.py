@@ -172,6 +172,88 @@ def _bench_serve_decode_step():
     return lambda: fn(params, cache, tok)
 
 
+@register("serve.prefill_warm", "serve")
+def _bench_serve_prefill_warm():
+    """Warm-prefix prefill at a 75% shared-prefix ratio: 96 of 128
+    prompt tokens come from a cached segment, so the thunk forwards
+    only the 32-token suffix (prefill_resume). The ISSUE-4 acceptance
+    test compares this against a cold 128-token prefill and asserts
+    the >= 2x win."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_kubernetes.models import CONFIGS, init_params
+    from tpu_kubernetes.models.decode import prefill, prefill_resume
+
+    cfg = CONFIGS[_TEST_MODEL]
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    span = cfg.max_seq                      # 128-token prompt fills it
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(6), (1, span), 0, cfg.vocab_size, jnp.int32)
+    shared = (span * 3) // 4                # 75% shared prefix
+    _, base = prefill(params, prompt[:, :shared], cfg, max_seq=span)
+    suffix = prompt[:, shared:]
+    fn = jax.jit(lambda p, t, c: prefill_resume(p, t, cfg, c)[0])
+    return lambda: fn(params, suffix, base)
+
+
+def _early_exit_case(budget: int):
+    """Factory behind serve.decode_early_exit, parameterized by the
+    per-row budget so the scaling test can build the run-to-max case
+    (budget = the full bucketed run) with the same machinery: batch 4,
+    run_max_new 64, jitted 8-step decode_segment programs with the
+    host-side liveness check between segments — the server's segmented
+    loop in miniature."""
+    def make():
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_kubernetes.models import CONFIGS, init_params
+        from tpu_kubernetes.models.decode import decode_segment, prefill
+
+        cfg = CONFIGS[_TEST_MODEL]
+        params = init_params(jax.random.PRNGKey(3), cfg)
+        b, width, run_max = 4, 16, 64
+        span = width + run_max
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(7), (b, width), 0, cfg.vocab_size,
+            jnp.int32)
+        logits, cache = prefill(params, tokens, cfg, max_seq=span)
+        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        done0 = jnp.zeros((b,), bool)
+        k_steps = 8
+        total = run_max - 1
+        programs: dict[int, Any] = {}
+
+        def segment(steps):
+            if steps not in programs:
+                programs[steps] = jax.jit(functools.partial(
+                    decode_segment, cfg=cfg, steps=steps))
+            return programs[steps]
+
+        def thunk():
+            tok, done, c = first, done0, cache
+            emitted = 1
+            run = 0
+            while run < total and emitted < budget:
+                steps = min(k_steps, total - run)
+                _, tok, done, c = segment(steps)(params, c, tok, done)
+                emitted += steps
+                run += steps
+            return tok
+
+        return thunk
+    return make
+
+
+# all four rows want only 8 tokens: wall time should track the longest
+# LIVE row (~8 steps), not the bucketed max (63) — the scaling test
+# rebuilds this with budget=64 and asserts the gap
+register("serve.decode_early_exit", "serve")(_early_exit_case(8))
+
+
 @register("train.step", "train")
 def _bench_train_step():
     import functools
@@ -395,7 +477,8 @@ def detect(current: dict[str, float], baseline: dict[str, float],
       regress — this is also the whole-history-empty case);
     * metric only in the baseline → ``missing`` (reported, not failing:
       benches get renamed/retired and a perf gate must not fossilize
-      the metric set).
+      the metric set — ``run(require_baseline=True)`` opts the CI gate
+      into failing on it).
     """
     checks: list[Check] = []
     for name in sorted(set(current) | set(baseline)):
@@ -426,9 +509,18 @@ def run(suite: str = "all", *, check: bool = False, as_json: bool = False,
         history_dir: str = DEFAULT_HISTORY_DIR, baseline: str | None = None,
         threshold: float = DEFAULT_THRESHOLD, n: int = 5, warmup: int = 2,
         only: str | None = None, window: int = DEFAULT_WINDOW,
-        out=None) -> int:
+        require_baseline: bool = False, out=None) -> int:
     """Run a suite (or all), append history, optionally gate on
-    regressions. Returns the process exit code (0 ok, 3 regression)."""
+    regressions. Returns the process exit code (0 ok, 3 regression).
+
+    ``require_baseline`` (with ``check``) also fails the gate when a
+    BASELINED metric is absent from the run — by default missing
+    metrics only report (benches get renamed/retired), but the CI gate
+    (``make perf-check``) runs full suites against the committed
+    baseline, where a hole means a silently-deleted bench. Scoped to
+    the suites actually run (a ``--suite serve`` run is not failed for
+    the train metric it never attempted); don't combine with ``only``,
+    which makes every unselected bench a hole."""
     out = out if out is not None else sys.stdout
     suites = list(SUITES) if suite == "all" else [suite]
 
@@ -460,8 +552,14 @@ def run(suite: str = "all", *, check: bool = False, as_json: bool = False,
         current = {name: r.median_seconds for name, r in results.items()}
         if shared_baseline is not None:
             # scope the shared baseline to this suite's metrics so the
-            # other suites' metrics don't show up as "missing" here
-            base = {k: v for k, v in base.items() if k in current}
+            # other suites' metrics don't show up as "missing" here;
+            # under require_baseline keep this suite's baselined names
+            # (by their "<suite>." prefix) even when absent from the
+            # run — those ARE the holes the strict gate must see
+            base = {k: v for k, v in base.items()
+                    if k in current
+                    or (require_baseline
+                        and k.split(".", 1)[0] == s)}
         report = detect(current, base, threshold=threshold) if check else None
 
         entry = make_entry(s, results, n)
@@ -475,8 +573,12 @@ def run(suite: str = "all", *, check: bool = False, as_json: bool = False,
         if report:
             reports.append(report)
 
+    missing = [c for r in reports for c in r.checks
+               if c.status == "missing"]
     rc = 0
     if check and any(not r.ok for r in reports):
+        rc = EXIT_REGRESSION
+    if check and require_baseline and missing:
         rc = EXIT_REGRESSION
 
     if as_json:
@@ -503,13 +605,16 @@ def run(suite: str = "all", *, check: bool = False, as_json: bool = False,
                   f"(baseline {_fmt_s(c.baseline).strip()})", file=out)
     if check:
         bad = [c for r in reports for c in r.regressions]
-        if bad:
-            for c in bad:
-                print(
-                    f"REGRESSION: {c.name} x{c.ratio:g} over baseline "
-                    f"({_fmt_s(c.baseline).strip()} -> "
-                    f"{_fmt_s(c.current).strip()}, threshold "
-                    f"x{threshold:g})", file=out)
-        else:
+        for c in bad:
+            print(
+                f"REGRESSION: {c.name} x{c.ratio:g} over baseline "
+                f"({_fmt_s(c.baseline).strip()} -> "
+                f"{_fmt_s(c.current).strip()}, threshold "
+                f"x{threshold:g})", file=out)
+        if require_baseline:
+            for c in missing:
+                print(f"MISSING: {c.name} is baselined but absent from "
+                      "this run (--require-baseline)", file=out)
+        if rc == 0:
             print(f"perf check ok (threshold x{threshold:g})", file=out)
     return rc

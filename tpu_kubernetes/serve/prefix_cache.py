@@ -1,0 +1,156 @@
+"""Bounded LRU of KV-cache prompt prefixes (the serve-side prefix
+reuse store behind ``SERVE_PREFIX_CACHE_MB``).
+
+A request whose prompt shares a token-id prefix with an earlier request
+can skip recomputing that prefix's K/V: causality makes cache slot i a
+pure function of tokens ≤ i, so ANY stored segment is reusable up to
+the longest common prefix with the new prompt — even a *partial* match
+against a longer stored entry is valid (the first q slots of a p-slot
+segment are exactly what a fresh prefill of those q tokens computes).
+
+This module is deliberately a plain container: it stores opaque device
+arrays (shaped (layers, 1, kv_heads, n_tokens, head_dim) by the server's
+convention, plus int8 scales when KV-quantized) and never imports jax —
+the server module must import without jax, and so must this one. All
+slicing/padding of the arrays happens at the call site.
+
+Sizing is byte-accurate (``arr.size * arr.dtype.itemsize`` summed over
+the stored arrays); eviction is least-recently-USED (lookup hits and
+covered inserts refresh recency). Subsumption keeps the store minimal:
+inserting ids already covered by a stored entry only refreshes that
+entry; inserting an extension REPLACES the shorter entry. Thread-safe —
+handler threads and the batch dispatcher share one store."""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+def _arrays_nbytes(arrays: dict[str, Any]) -> int:
+    return sum(
+        int(a.size) * int(a.dtype.itemsize)
+        for a in arrays.values() if a is not None
+    )
+
+
+def _common_prefix_len(a: tuple, b: tuple) -> int:
+    n = min(len(a), len(b))
+    i = 0
+    while i < n and a[i] == b[i]:
+        i += 1
+    return i
+
+
+@dataclass
+class PrefixEntry:
+    """One cached prefix: ``arrays`` hold exactly ``len(ids)`` filled
+    positions (the server trims pad garbage before inserting)."""
+
+    ids: tuple[int, ...]
+    arrays: dict[str, Any]
+    nbytes: int = field(init=False)
+
+    def __post_init__(self):
+        self.nbytes = _arrays_nbytes(self.arrays)
+
+
+class PrefixCache:
+    """Longest-prefix-match LRU over :class:`PrefixEntry`, capped at
+    ``max_bytes``. ``sig`` records the model/config signature the
+    segments were computed under — one store never serves two models,
+    but the signature makes that checkable (``stats()``) instead of
+    implicit. ``on_bytes`` (when given) observes the post-op total —
+    the server points it at the prefix-cache bytes gauge."""
+
+    def __init__(self, max_bytes: int, sig: tuple = (),
+                 on_bytes: Callable[[int], None] | None = None):
+        if max_bytes <= 0:
+            raise ValueError(f"max_bytes must be > 0, got {max_bytes}")
+        self.max_bytes = int(max_bytes)
+        self.sig = tuple(sig)
+        self._on_bytes = on_bytes
+        self._entries: OrderedDict[tuple, PrefixEntry] = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    # -- internals (caller holds the lock) ---------------------------------
+
+    def _notify(self) -> None:
+        if self._on_bytes is not None:
+            self._on_bytes(self._bytes)
+
+    def _evict_to_cap(self) -> None:
+        while self._bytes > self.max_bytes and self._entries:
+            _, old = self._entries.popitem(last=False)   # least recent
+            self._bytes -= old.nbytes
+
+    # -- API ---------------------------------------------------------------
+
+    def lookup(self, ids: list[int]) -> tuple[int, PrefixEntry | None]:
+        """Longest common prefix across the store → (match length, the
+        matching entry). A hit refreshes the entry's recency. (0, None)
+        when nothing shares even one token."""
+        key = tuple(ids)
+        with self._lock:
+            best_q, best = 0, None
+            for entry in self._entries.values():
+                q = _common_prefix_len(entry.ids, key)
+                if q > best_q:
+                    best_q, best = q, entry
+            if best is not None:
+                self._entries.move_to_end(best.ids)
+            return best_q, best
+
+    def insert(self, ids: list[int], arrays: dict[str, Any]) -> bool:
+        """Store a segment for ``ids`` (arrays trimmed to len(ids)
+        positions). Returns True when stored; False when skipped — ids
+        already covered by a stored entry (recency refreshed instead)
+        or the segment alone exceeds the cap. Inserting an EXTENSION of
+        a stored prefix replaces the shorter entry; eviction then drops
+        least-recently-used entries until the total fits the cap."""
+        key = tuple(ids)
+        if not key:
+            return False
+        entry = PrefixEntry(ids=key, arrays=arrays)
+        if entry.nbytes > self.max_bytes:
+            return False
+        with self._lock:
+            for have in list(self._entries.values()):
+                q = _common_prefix_len(have.ids, key)
+                if q == len(key) and len(have.ids) >= len(key):
+                    # covered: the stored entry serves this prefix and
+                    # more — storing again would only duplicate bytes
+                    self._entries.move_to_end(have.ids)
+                    self._notify()
+                    return False
+                if q == len(have.ids) and len(key) > len(have.ids):
+                    # extension: the new segment subsumes the stored one
+                    del self._entries[have.ids]
+                    self._bytes -= have.nbytes
+            self._entries[key] = entry
+            self._bytes += entry.nbytes
+            self._evict_to_cap()
+            self._notify()
+            return True
+
+    @property
+    def bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        """The /healthz mirror: entry count, bytes vs cap, signature."""
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "sig": list(self.sig),
+            }

@@ -48,6 +48,11 @@ SERVE_TOKENIZER / SERVE_QUANT), plus SERVE_KV_QUANT for the int8 KV
 cache, SERVE_EOS_ID (tokens after it are truncated from responses),
 SERVER_HOST/SERVER_PORT, SERVER_BATCH/SERVER_BATCH_WINDOW_MS (dynamic
 batching), SERVE_MAX_NEW as the per-request ``max_new_tokens`` cap,
+SERVE_PREFIX_CACHE_MB (> 0 enables the prefix KV-cache: requests whose
+prompts share a token prefix with earlier traffic prefill only the
+suffix; bounded LRU, bytes gauge + hit/partial/miss counter),
+SERVE_EARLY_EXIT_STEPS (the greedy decode loop's host-side liveness
+check interval — finished rows stop costing decode steps),
 SERVE_MESH (e.g. ``tensor=4``) — tensor-sharded fused generation over
 this host's chips, so models bigger than one chip's HBM serve live
 (streaming and prompt-lookup stay single-device and say so) — and
@@ -171,6 +176,33 @@ INFLIGHT = REGISTRY.gauge(
     "requests currently inside a handler (the server-side queue depth "
     "a fleet monitor watches — generation serializes on one lock)",
 )
+PREFIX_CACHE_TOTAL = REGISTRY.counter(
+    "tpu_serve_prefix_cache_total",
+    "prefix KV-cache lookups by outcome (hit = a stored prefix fully "
+    "reused, partial = the prompt diverged inside a stored prefix, "
+    "miss = cold prefill; warm-up excluded)",
+    labelnames=("result",),
+)
+PREFIX_CACHED_TOKENS = REGISTRY.histogram(
+    "tpu_serve_prefix_cached_tokens",
+    "prompt tokens served from the prefix cache per warm prefill",
+    buckets=(16.0, 32.0, 64.0, 128.0, 256.0, 512.0, 1024.0, 2048.0),
+)
+PREFIX_CACHE_BYTES = REGISTRY.gauge(
+    "tpu_serve_prefix_cache_bytes",
+    "resident bytes of cached KV prefix segments (LRU-evicted under "
+    "the SERVE_PREFIX_CACHE_MB cap)",
+)
+DECODE_STEPS_SAVED = REGISTRY.counter(
+    "tpu_serve_decode_steps_saved_total",
+    "decode scan steps skipped by the all-rows-done early exit "
+    "(per dispatched generation, vs the bucketed run length)",
+)
+BATCH_TAINT = REGISTRY.counter(
+    "tpu_serve_batch_taint_total",
+    "dispatcher selection failures that tainted a whole pending round "
+    "(every selected entry fails out; submit() never hangs)",
+)
 # device-synced phase attribution (obs/profile.py): prefill / decode /
 # fused-generate device seconds split by mode — "compile" is a program's
 # first call (jit trace + XLA compile ride on it), "execute" is steady
@@ -186,6 +218,25 @@ PROFILER = PhaseProfiler(
 def _bucket(n: int, lo: int = 16) -> int:
     b = lo
     while b < n:
+        b *= 2
+    return b
+
+
+# prompts below this length are not worth a prefix-cache entry (warm-up
+# probes would pollute the store) and matches below it are not worth a
+# resume program; also the floor of _pow2_floor
+MIN_PREFIX_TOKENS = 16
+
+
+def _pow2_floor(n: int, lo: int = MIN_PREFIX_TOKENS) -> int:
+    """Largest power of two <= n (0 when n < lo). Reused-prefix lengths
+    are quantized DOWN to powers of two so the resume programs keep the
+    O(log max_seq) shape discipline of everything else — a raw match
+    length would mean one compile per distinct prefix."""
+    if n < lo:
+        return 0
+    b = lo
+    while b * 2 <= n:
         b *= 2
     return b
 
@@ -215,8 +266,8 @@ class _Batcher:
     arrival, waits ``window_ms`` for co-riders, takes up to
     ``max_batch``, runs ONE batched program, and fans the per-row
     results back. Each row is truncated to its own requested
-    max_new_tokens (the batch runs to the max), so co-riding never
-    changes a response."""
+    max_new_tokens (the batch runs to the max — or until the early-exit
+    check finds no row live), so co-riding never changes a response."""
 
     def __init__(self, run_batch, max_batch: int, window_ms: float,
                  fits=None):
@@ -232,14 +283,18 @@ class _Batcher:
         self._thread = threading.Thread(target=self._dispatch, daemon=True)
         self._thread.start()
 
-    def enqueue(self, ids: list, max_new: int) -> dict:
+    def enqueue(self, ids: list, max_new: int,
+                budget: int | None = None) -> dict:
         """Queue a request; returns the entry. ``entry["dispatched"]``
         fires when the dispatcher selects it into a batch (the end of its
         queue wait) and ``entry["event"]`` when its result is ready —
         split so the caller can time the two stages as separate trace
-        spans."""
+        spans. ``budget`` is the REQUESTED max_new (≤ the bucketed
+        ``max_new`` the program runs) — the early-exit decode loop stops
+        counting a row live once its own budget is emitted."""
         entry = {
             "ids": ids, "max_new": max_new, "t_enq": time.monotonic(),
+            "budget": max_new if budget is None else budget,
             "event": threading.Event(), "dispatched": threading.Event(),
             "tokens": None, "error": None,
         }
@@ -290,6 +345,7 @@ class _Batcher:
             except Exception as e:  # noqa: BLE001 — selection failure
                 batch, rest = pending, []    # taints the whole round
                 err = e
+                BATCH_TAINT.inc()
             else:
                 # queue-wait = enqueue → dispatch (the latency cost of
                 # waiting for co-riders); batch size = rows that co-rode
@@ -457,15 +513,52 @@ class ServingState:
                 float(env.get("SERVER_BATCH_WINDOW_MS", "5")),
                 fits=fits,
             )
+
+        # SERVE_EARLY_EXIT_STEPS: the host-side liveness check interval
+        # of the segmented greedy decode loop — every K jitted steps the
+        # host asks "is any row still live?" and stops the generation
+        # early instead of running to the bucketed max. <= 0 disables
+        # the mid-run checks (one segment runs the whole budget).
+        self.early_exit_steps = int(env.get("SERVE_EARLY_EXIT_STEPS", "8"))
+        # SERVE_PREFIX_CACHE_MB (> 0 enables): bounded LRU of prompt-
+        # prefix KV segments (serve/prefix_cache.py). A request sharing
+        # a stored prefix prefills only its suffix — into the SAME cache
+        # geometry as a cold prefill, so every downstream program is
+        # shared and greedy tokens stay identical (up to the documented
+        # chunked-scoring float caveat, prefill_chunked). Single-device
+        # dense models only: the fused sharded path has no resume form,
+        # and MoE capacity depends on the prefill chunk length (reuse
+        # would not be token-exact) — both warn and serve cold.
+        self.prefix_cache = None
+        prefix_mb = float(env.get("SERVE_PREFIX_CACHE_MB", "0") or "0")
+        if prefix_mb > 0 and (self.mesh is not None
+                              or isinstance(cfg, MoEConfig)):
+            log.warn(
+                "SERVE_PREFIX_CACHE_MB ignored: prefix reuse needs a "
+                "single-device dense model (sharded serving is fused; "
+                "MoE capacity is chunk-length-dependent)"
+            )
+        elif prefix_mb > 0:
+            from tpu_kubernetes.serve.prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(
+                int(prefix_mb * 2 ** 20),
+                sig=(
+                    self.model_name,
+                    getattr(cfg.dtype, "__name__", str(cfg.dtype)),
+                    bool(self.kv_quant),
+                ),
+                on_bytes=PREFIX_CACHE_BYTES.set,
+            )
         self.ready = False
 
     def warm(self) -> None:
-        """Compile the programs DEFAULT requests use — the fused
-        generate at the full max_new_tokens cap AND the streaming pair
-        (prefill + decode step), greedy, smallest bucket — before going
-        ready, so the readiness flip means real traffic (either mode)
-        runs at full speed. Sharded serving warms only the fused path
-        (streaming is rejected there)."""
+        """Compile the programs DEFAULT requests use — the segmented
+        greedy pair (prefill + decode segments) at the full
+        max_new_tokens cap AND the streaming step program, smallest
+        bucket — before going ready, so the readiness flip means real
+        traffic (either mode) runs at full speed. Sharded serving warms
+        the fused program instead (its only path)."""
         self.complete("")
         if self.mesh is None:
             for _ in self.stream(""):
@@ -572,13 +665,224 @@ class ServingState:
             padded[i, :len(r)] = r
         return padded
 
+    # -- prefix KV-cache reuse + early-exit decode (the segmented greedy
+    # hot path: single-device, dense; SERVE_PREFIX_CACHE_MB /
+    # SERVE_EARLY_EXIT_STEPS) ----------------------------------------------
+
+    def _prefix_lookup(self, ids: list):
+        """Longest-match against the prefix store, floored to a power of
+        two (compile discipline) and capped at len(ids)-1 — the resume
+        chunk needs at least one real token to produce last-position
+        logits. Returns (reused_tokens, entry | None) and records the
+        hit/partial/miss counter + reused-tokens histogram ("hit" = the
+        prompt extends a stored prefix; "partial" = it diverged inside
+        one; ready-gated like the token counters)."""
+        m, entry = self.prefix_cache.lookup(ids)
+        q = _pow2_floor(min(m, len(ids) - 1))
+        if entry is None or q < MIN_PREFIX_TOKENS:
+            q, entry, result = 0, None, "miss"
+        else:
+            result = "hit" if m >= len(entry.ids) else "partial"
+        if self.ready:
+            PREFIX_CACHE_TOTAL.labels(result).inc()
+            if q:
+                PREFIX_CACHED_TOKENS.observe(float(q))
+        return q, entry
+
+    def _prefix_insert(self, ids: list, cache, row: int = 0) -> None:
+        """Store row ``row``'s first len(ids) cache slots — its REAL
+        prompt positions, which by causality are exactly what a fresh
+        prefill of those tokens computes, so the segment is valid for
+        ANY continuation. Pad garbage (slots >= len(ids)) never enters
+        the store."""
+        n = len(ids)
+        if self.prefix_cache is None or n < MIN_PREFIX_TOKENS:
+            return
+        arrays = {
+            "k": cache.k[:, row:row + 1, :, :n],
+            "v": cache.v[:, row:row + 1, :, :n],
+        }
+        if cache.k_scale is not None:
+            arrays["k_scale"] = cache.k_scale[:, row:row + 1, :, :n]
+            arrays["v_scale"] = cache.v_scale[:, row:row + 1, :, :n]
+        self.prefix_cache.insert(ids, arrays)
+
+    def _expand_prefix(self, arrays: dict, q: int, span: int, b: int):
+        """A stored segment → the resume base cache: ``q`` real slots,
+        padded out to ``span`` and broadcast to ``b`` identical rows
+        (batch warm starts replicate row 0, like _pad_rows). Pad values
+        match init_cache (zeros; scale 1.0), so outside the real slots a
+        warm cache is bitwise a cold one."""
+        import jax.numpy as jnp
+
+        from tpu_kubernetes.models.decode import KVCache
+
+        def grow(a, pad_value=0):
+            if a is None:
+                return None
+            a = a[:, :, :, :q] if a.ndim == 4 else a[:, :, :, :q, :]
+            if b > 1:
+                a = jnp.broadcast_to(a, (a.shape[0], b) + a.shape[2:])
+            pad = [(0, 0)] * a.ndim
+            pad[3] = (0, span - q)
+            return jnp.pad(a, pad, constant_values=pad_value)
+
+        return KVCache(
+            k=grow(arrays["k"]), v=grow(arrays["v"]),
+            length=jnp.asarray(q, jnp.int32),
+            k_scale=grow(arrays.get("k_scale"), 1.0),
+            v_scale=grow(arrays.get("v_scale"), 1.0),
+        )
+
+    def _prefill_cold(self, padded, lengths: list, span: int):
+        """The jitted ragged prefill at this span (shared with the
+        streaming path — same ("prefill", span) key)."""
+        jax = self._jax
+        import functools
+
+        import jax.numpy as jnp
+
+        from tpu_kubernetes.models.decode import prefill
+
+        pf = self._cached_program(
+            ("prefill", span),
+            lambda: jax.jit(functools.partial(
+                prefill, cfg=self.cfg, max_seq=span,
+                kv_quant=self.kv_quant,
+            )),
+        )
+        with PROFILER.phase(
+            "prefill", key=("prefill", span), tracer=TRACER,
+        ) as pp:
+            logits, cache = pp.sync(pf(
+                self.params, jnp.asarray(padded),
+                lengths=jnp.asarray(lengths, jnp.int32),
+            ))
+        return logits, cache
+
+    def _prefill_warm(self, ids: list, entry, q: int, width: int,
+                      span: int, b: int = 1):
+        """Resume from ``q`` cached prefix slots: prefill ONLY the
+        suffix, into the same cache geometry as a cold prefill at this
+        width — the suffix chunk spans [q, width), so prompt_slots /
+        prompt_lengths / span (and therefore every downstream decode
+        program) are shared with the cold path. Profiled as its own
+        "prefill_warm" phase so /debug/profile splits warm from cold."""
+        jax = self._jax
+        import functools
+
+        import jax.numpy as jnp
+
+        from tpu_kubernetes.models.decode import prefill_resume
+
+        rs = self._cached_program(
+            ("prefill_resume", span),
+            lambda: jax.jit(functools.partial(
+                prefill_resume, cfg=self.cfg,
+            )),
+        )
+        base = self._expand_prefix(entry.arrays, q, span, b)
+        suffix = self._pad_rows([ids[q:]] * b, width - q)
+        with PROFILER.phase(
+            "prefill_warm", key=("prefill_resume", span), tracer=TRACER,
+        ) as pp:
+            logits, cache = pp.sync(rs(
+                self.params, jnp.asarray(suffix), cache=base,
+                lengths=jnp.asarray([len(ids) - q] * b, jnp.int32),
+            ))
+        return logits, cache
+
+    def _prefill_any(self, ids: list, width: int, span: int, b: int = 1):
+        """Warm-or-cold prefill of ``b`` identical rows of ``ids`` →
+        (last-position logits, cache). Prefix lookup, the post-prefill
+        insert, and the cache metrics all live here so every solo call
+        site (complete / stream / single-entry batch rounds) shares one
+        policy."""
+        q, entry = (0, None)
+        if self.prefix_cache is not None:
+            q, entry = self._prefix_lookup(ids)
+        if entry is not None:
+            logits, cache = self._prefill_warm(ids, entry, q, width,
+                                               span, b)
+        else:
+            logits, cache = self._prefill_cold(
+                self._pad_rows([ids] * b, width), [len(ids)] * b, span,
+            )
+        self._prefix_insert(ids, cache)
+        return logits, cache
+
+    def _decode_masked(self, cache, first, budgets: list,
+                       run_max_new: int, b: int):
+        """Greedy decode with per-row done-masking and an all-rows-done
+        early exit: jitted ``decode_segment`` programs of
+        SERVE_EARLY_EXIT_STEPS steps each, with a host-side liveness
+        check BETWEEN segments (off the per-step critical path). A row
+        is live until its EOS appears or its own requested ``budget`` is
+        emitted; once no row is live the remaining steps are skipped
+        (tpu_serve_decode_steps_saved_total counts them). Token-exact
+        vs the fused run-to-max scan: masking only decides when the loop
+        may STOP, never what a row emits. → (tokens (b, 1+steps_run)
+        ndarray, steps_run)."""
+        jax = self._jax
+        import functools
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_kubernetes.models.decode import decode_segment
+
+        eos = self.eos_id
+        done = (
+            first == eos if eos is not None
+            else jnp.zeros((b,), bool)
+        )
+        total = run_max_new - 1       # steps left after the first token
+        k_steps = (
+            self.early_exit_steps if self.early_exit_steps > 0 else total
+        )
+        pieces = [np.asarray(first)[:, None]]
+        tok = first
+        emitted = 1
+        steps_run = 0
+        while steps_run < total:
+            done_h = np.asarray(done)
+            if not any(
+                budgets[i] > emitted and not done_h[i] for i in range(b)
+            ):
+                break
+            steps = min(k_steps, total - steps_run)
+            seg = self._cached_program(
+                ("segment", steps),
+                lambda: jax.jit(functools.partial(
+                    decode_segment, cfg=self.cfg, steps=steps,
+                    eos_id=eos, pad_id=0,
+                )),
+            )
+            with PROFILER.phase(
+                "decode", key=("segment", steps), tracer=TRACER,
+            ) as pd:
+                toks, tok, done, cache = pd.sync(
+                    seg(self.params, cache, tok, done)
+                )
+            pieces.append(np.asarray(toks))
+            emitted += steps
+            steps_run += steps
+        saved = total - steps_run
+        if saved > 0 and self.ready:
+            DECODE_STEPS_SAVED.inc(saved)
+        return np.concatenate(pieces, axis=1), steps_run
+
     def _run_greedy_batch(self, entries: list) -> None:
         """Dispatcher callback: run up to SERVER_BATCH queued greedy
         requests as ONE ragged batch (static batch dim — pad rows
         replicate row 0) and set each entry's tokens. A row truncated to
         its own max_new is identical to generating that much alone:
         greedy emission is left-to-right and ragged rows are
-        independent."""
+        independent. Single-device, this is the segmented hot path —
+        warm-prefix prefill for single-entry rounds and early-exit
+        decode (pad rows get budget 1, so they never keep the batch
+        alive); under SERVE_MESH the fused sharded program runs to the
+        bucketed max."""
         jax = self._jax
         import jax.numpy as jnp
         import numpy as np
@@ -588,21 +892,45 @@ class ServingState:
         width = _bucket(max(len(e["ids"]) for e in entries))
         rows = [e["ids"] for e in entries]
         rows += [rows[0]] * (b - len(rows))
-        padded = self._pad_rows(rows, width)
-        lengths = jnp.asarray([len(r) for r in rows], jnp.int32)
 
-        fn = self._program(max_new, 0.0, 0, 0.0)
+        if self.mesh is not None:
+            padded = self._pad_rows(rows, width)
+            lengths = jnp.asarray([len(r) for r in rows], jnp.int32)
+            fn = self._program(max_new, 0.0, 0, 0.0)
+            with self._lock:
+                with PROFILER.phase(
+                    "generate",
+                    key=("generate", max_new, 0.0, 0, 0.0, width, b),
+                    tracer=TRACER,
+                ) as pg:
+                    out = pg.sync(fn(
+                        self.params, jnp.asarray(padded),
+                        rng=jax.random.PRNGKey(0), prompt_lengths=lengths,
+                    ))
+                tokens = np.asarray(out)
+            for i, entry in enumerate(entries):
+                entry["tokens"] = tokens[i][:entry["max_new"]].tolist()
+            return
+
+        span = width + max_new
+        budgets = [e.get("budget", e["max_new"]) for e in entries]
+        budgets += [1] * (b - len(entries))   # pad rows finish instantly
         with self._lock:
-            with PROFILER.phase(
-                "generate",
-                key=("generate", max_new, 0.0, 0, 0.0, width, b),
-                tracer=TRACER,
-            ) as pg:
-                out = pg.sync(fn(
-                    self.params, jnp.asarray(padded),
-                    rng=jax.random.PRNGKey(0), prompt_lengths=lengths,
-                ))
-            tokens = np.asarray(out)
+            if len(entries) == 1:
+                # all rows replicate row 0 → the solo warm-or-cold path
+                # (prefix lookup + insert) applies to the whole batch
+                logits, cache = self._prefill_any(rows[0], width, span, b)
+            else:
+                logits, cache = self._prefill_cold(
+                    self._pad_rows(rows, width),
+                    [len(r) for r in rows], span,
+                )
+                for i, entry in enumerate(entries):
+                    self._prefix_insert(entry["ids"], cache, row=i)
+            first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            tokens, _ = self._decode_masked(
+                cache, first, budgets, max_new, b
+            )
         for i, entry in enumerate(entries):
             entry["tokens"] = tokens[i][:entry["max_new"]].tolist()
 
@@ -804,11 +1132,26 @@ class ServingState:
             # The queue span ends when the dispatcher SELECTS the entry,
             # the batch span when its rows come back — the same boundary
             # QUEUE_SECONDS measures.
-            entry = self._batcher.enqueue(ids, run_max_new)
+            entry = self._batcher.enqueue(ids, run_max_new,
+                                          budget=max_new)
             with TRACER.phase("queue", quiet=True):
                 entry["dispatched"].wait()
             with TRACER.phase("batch", quiet=True, mode="batched"):
                 tokens = self._batcher.result(entry)
+        elif greedy_default and self.mesh is None:
+            # solo greedy, single device: the segmented hot path —
+            # warm-prefix prefill when the store holds a match, then
+            # early-exit decode that stops at the REQUESTED budget (or
+            # EOS) instead of scanning to the bucketed run length
+            span = width + run_max_new
+            with self._locked_phase():
+                with TRACER.phase("batch", quiet=True, mode="solo"):
+                    logits, cache = self._prefill_any(ids, width, span)
+                    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    out, _ = self._decode_masked(
+                        cache, first, [max_new], run_max_new, 1
+                    )
+                    tokens = out[0].tolist()
         else:
             fn = self._program(run_max_new, float(temperature), int(top_k),
                                float(top_p))
@@ -864,12 +1207,9 @@ class ServingState:
         the delta of the decoded prefix, so tokenizers whose characters
         span tokens never emit split multi-byte sequences."""
         jax = self._jax
-        import functools
-
-        import jax.numpy as jnp
         import numpy as np
 
-        from tpu_kubernetes.models.decode import _sample, decode_step, prefill
+        from tpu_kubernetes.models.decode import _sample, decode_step
 
         if self.mesh is not None:
             # the per-token streaming loop is single-device; the fused
@@ -889,21 +1229,16 @@ class ServingState:
                 ids, width, run_max_new, max_new, finish
             )
             return
-        padded = self._pad_rows([ids], width)
         cfg = self.cfg
 
-        # keyed by the SPAN (the only static the compile depends on):
-        # different (width, max_new) pairs with one span share a program,
-        # keeping the O(log max_seq)-programs discipline. The span and
-        # rng schedule use the BUCKETED run_max_new so a seed draws the
-        # same tokens as the fused path; the loop stops at the request.
+        # prefill programs are keyed by the SPAN (the only static the
+        # compile depends on): different (width, max_new) pairs with one
+        # span share a program, keeping the O(log max_seq)-programs
+        # discipline. The span and rng schedule use the BUCKETED
+        # run_max_new so a seed draws the same tokens as the fused path;
+        # the loop stops at the request. _prefill_any serves the prefix
+        # store when enabled — a warm prefill cuts time-to-first-token.
         span = width + run_max_new
-        pf = self._cached_program(
-            ("prefill", span),
-            lambda: jax.jit(functools.partial(
-                prefill, cfg=cfg, max_seq=span, kv_quant=self.kv_quant,
-            )),
-        )
 
         def _build_step():
             def _step(params, cache, tok, rng):
@@ -933,13 +1268,7 @@ class ServingState:
         def tokens():
             if self.ready:
                 PROMPT_TOKENS.inc(len(ids))
-            with PROFILER.phase(
-                "prefill", key=("prefill", span), tracer=TRACER,
-            ) as pp:
-                logits, cache = pp.sync(pf(
-                    self.params, jnp.asarray(padded),
-                    lengths=jnp.asarray([len(ids)], jnp.int32),
-                ))
+            logits, cache = self._prefill_any(ids, width, span)
             tok = _sample(
                 logits, first_rng, float(temperature), int(top_k),
                 float(top_p),
@@ -1124,6 +1453,10 @@ class _Handler(BaseHTTPRequestHandler):
                 "prompt_tokens": int(PROMPT_TOKENS.value),
             },
         }
+        if st.prefix_cache is not None:
+            # entries / bytes-vs-cap / signature — the LRU's one-glance
+            # mirror (the bytes gauge rides /metrics)
+            body["prefix_cache"] = st.prefix_cache.stats()
         if st.prompt_lookup:
             with st._spec_lock:
                 t = dict(st.spec_totals)
