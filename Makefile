@@ -3,7 +3,7 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast obs-check monitor-check perf-check serve-identity-check bench dryrun native dist dist-offline clean
+.PHONY: test test-fast obs-check monitor-check perf-check serve-identity-check serve-continuous-check bench dryrun native dist dist-offline clean
 
 test:
 	python -m pytest tests/ -q
@@ -49,10 +49,23 @@ perf-check:
 
 # Quick pre-commit identity gate for the serve hot path: only the greedy
 # token-identity tests (warm-prefix vs cold prefill, early-exit vs
-# run-to-max decode, batched vs solo — fp32 and int8 KV cache).
+# run-to-max decode, batched/continuous vs solo — fp32 and int8 KV cache).
 serve-identity-check:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py \
-	  tests/test_serve_prefix.py -q -m "not slow" -k identity
+	  tests/test_serve_prefix.py tests/test_serve_continuous.py \
+	  -q -m "not slow" -k identity
+
+# Continuous-batching gate: the slot-engine unit + e2e tests, the full
+# identity suite, and the timing acceptance criterion (continuous >= 1.5x
+# round-based tokens/sec on the staggered trace — slow-marked, so tier-1
+# skips it but this target runs it).
+serve-continuous-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serve_continuous.py \
+	  "tests/test_decode.py::test_cache_insert_clear_row_roundtrip" \
+	  "tests/test_decode.py::test_cache_insert_row_rejects_bad_rows" \
+	  "tests/test_decode.py::test_slot_decode_identity_with_solo_decode" \
+	  "tests/test_perfbench.py::test_continuous_decode_beats_round_based_dispatch" \
+	  -q
 
 bench:
 	python bench.py

@@ -451,3 +451,152 @@ def test_ragged_decode_chunk_matches_sequential_steps(params):
     )
     assert int(c_chunk.length) == int(c_step.length)
     assert c_chunk.prompt_lengths is not None
+
+
+# ---------------------------------------------------------------------------
+# continuous batching primitives: slot-cache surgery + mixed-position decode
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_cache_insert_clear_row_roundtrip(params, kv_quant):
+    """insert then clear must leave the slot bitwise equal to a cold
+    cache (and other rows untouched) — a recycled engine and a fresh
+    one see identical state."""
+    from tpu_kubernetes.models.decode import (
+        cache_clear_row,
+        cache_insert_row,
+        init_cache,
+    )
+
+    prompt = jax.random.randint(jax.random.PRNGKey(50), (1, 8), 0,
+                                CFG.vocab_size)
+    _, row = prefill(params, prompt, CFG, max_seq=8, kv_quant=kv_quant)
+    cold = init_cache(CFG, 4, 32, kv_quant=kv_quant)
+
+    cache = cache_insert_row(cold, row, 2)
+    np.testing.assert_array_equal(
+        np.asarray(cache.k[:, 2, :, :8]), np.asarray(row.k[:, 0])
+    )
+    # the insert touches ONLY its slot
+    for other in (0, 1, 3):
+        np.testing.assert_array_equal(
+            np.asarray(cache.k[:, other]), np.asarray(cold.k[:, other])
+        )
+
+    cleared = cache_clear_row(cache, 2)
+    for a, b in zip(cleared, cold):
+        if a is not None and hasattr(a, "shape"):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_cache_insert_row_rejects_bad_rows(params):
+    from tpu_kubernetes.models.decode import cache_insert_row, init_cache
+
+    prompt = jax.random.randint(jax.random.PRNGKey(51), (1, 8), 0,
+                                CFG.vocab_size)
+    _, row = prefill(params, prompt, CFG, max_seq=8)
+    with pytest.raises(ValueError, match="exceeds engine max_seq"):
+        cache_insert_row(init_cache(CFG, 4, 4), row, 0)
+    _, wide = prefill(
+        params, jnp.tile(prompt, (2, 1)), CFG, max_seq=8
+    )
+    with pytest.raises(ValueError, match="batch-1"):
+        cache_insert_row(init_cache(CFG, 4, 32), wide, 0)
+    _, qrow = prefill(params, prompt, CFG, max_seq=8, kv_quant=True)
+    with pytest.raises(ValueError, match="kv-quant mismatch"):
+        cache_insert_row(init_cache(CFG, 4, 32), qrow, 0)
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_slot_decode_identity_with_solo_decode(params, kv_quant):
+    """Rows inserted at different widths/slots and decoded as one mixed
+    batch (decode_segment_slots) must emit exactly what each row emits
+    decoded solo (prefill + decode_segment) — the identity the serve
+    engine rests on. Mid-stream admission included: the third request
+    joins after the first segment."""
+    from tpu_kubernetes.models.decode import (
+        SlotState,
+        cache_insert_row,
+        decode_segment,
+        decode_segment_slots,
+        init_cache,
+        init_slot_state,
+    )
+
+    plens = [6, 11, 9]
+    widths = [8, 16, 16]
+    budgets = [9, 4, 6]
+    prompts = [
+        jax.random.randint(jax.random.PRNGKey(60 + i), (1, n), 0,
+                           CFG.vocab_size)
+        for i, n in enumerate(plens)
+    ]
+
+    # solo references: run-to-budget greedy over each row alone
+    refs = []
+    for i in range(3):
+        padded = jnp.pad(prompts[i], ((0, 0), (0, widths[i] - plens[i])))
+        logits, cache = prefill(
+            params, padded, CFG, max_seq=widths[i] + budgets[i],
+            lengths=jnp.asarray([plens[i]], jnp.int32),
+            kv_quant=kv_quant,
+        )
+        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        toks, _, _, _ = decode_segment(
+            params, cache, first, jnp.zeros((1,), bool), CFG,
+            steps=budgets[i] - 1,
+        )
+        refs.append([int(first[0])] + np.asarray(toks)[0].tolist())
+
+    # engine in miniature: rows land in slots 2, 0 (slot 3 joins later)
+    rows, firsts = [], []
+    for i in range(3):
+        padded = jnp.pad(prompts[i], ((0, 0), (0, widths[i] - plens[i])))
+        logits, row = prefill(
+            params, padded, CFG, max_seq=widths[i],
+            lengths=jnp.asarray([plens[i]], jnp.int32),
+            kv_quant=kv_quant,
+        )
+        rows.append(row)
+        firsts.append(int(np.argmax(np.asarray(logits)[0])))
+
+    cache = init_cache(CFG, 4, CFG.max_seq, kv_quant=kv_quant)
+    st = init_slot_state(4)
+
+    def admit(cache, st, i, slot):
+        cache = cache_insert_row(cache, rows[i], slot)
+        st = st._replace(
+            tok=st.tok.at[slot].set(firsts[i]),
+            pos=st.pos.at[slot].set(widths[i]),
+            remaining=st.remaining.at[slot].set(budgets[i] - 1),
+            prompt_lengths=st.prompt_lengths.at[slot].set(plens[i]),
+            prompt_slots=st.prompt_slots.at[slot].set(widths[i]),
+        )
+        return cache, st
+
+    cache, st = admit(cache, st, 0, 2)
+    cache, st = admit(cache, st, 1, 0)
+    collected = {0: [firsts[0]], 1: [firsts[1]]}
+    slot_of = {0: 2, 1: 0}
+    admitted_third = False
+    while True:
+        old_pos = np.asarray(st.pos)
+        toks, st, cache = decode_segment_slots(params, cache, st, CFG,
+                                               steps=3)
+        new_pos = np.asarray(st.pos)
+        toks = np.asarray(toks)
+        # the server's bookkeeping rule: a row emitted exactly as many
+        # tokens as its pos advanced, so pads never reach results
+        for i, s in slot_of.items():
+            emitted = int(new_pos[s] - old_pos[s])
+            collected[i].extend(toks[s][:emitted].tolist())
+        if not admitted_third:                # mid-stream admission
+            cache, st = admit(cache, st, 2, 3)
+            collected[2] = [firsts[2]]
+            slot_of[2] = 3
+            admitted_third = True
+        if np.asarray(st.remaining).max() <= 0:
+            break
+    for i in range(3):
+        assert collected[i] == refs[i], f"row {i} diverged"

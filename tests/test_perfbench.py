@@ -44,6 +44,7 @@ def test_registry_covers_every_suite():
     assert "serve.decode_step" in BENCHES
     assert "serve.prefill_warm" in BENCHES
     assert "serve.decode_early_exit" in BENCHES
+    assert "serve.continuous_decode" in BENCHES
     assert "train.step" in BENCHES
 
 
@@ -176,6 +177,43 @@ def test_make_entry_shape():
     assert entry["version"]
     assert entry["results"]["ops.rms_norm"] == pytest.approx(
         r.median_seconds, abs=1e-6)
+
+
+@pytest.mark.slow
+def test_continuous_decode_beats_round_based_dispatch():
+    """The continuous-batching acceptance criterion: over the same
+    staggered trace (waves of one long + three short requests), the
+    slot engine's decode wall time must beat the round-based
+    dispatcher by >= 1.5x tokens/sec — short rows recycle their slots
+    between segments instead of riding dead until the wave's long row
+    drains. Timing-sensitive → slow-marked; `make serve-continuous-check`
+    runs it."""
+    import time
+
+    import jax
+
+    from tpu_kubernetes.obs.perfbench import _continuous_case
+
+    def median_seconds(make, n=5, warmup=3):
+        thunk = make()
+        for _ in range(warmup):
+            jax.block_until_ready(thunk())
+        times = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            jax.block_until_ready(thunk())
+            times.append(time.perf_counter() - t0)
+        return sorted(times)[n // 2]
+
+    round_based = median_seconds(_continuous_case(False))
+    continuous = median_seconds(_continuous_case(True))
+    # same token count both sides → the wall-time ratio IS the
+    # tokens/sec ratio
+    assert round_based / continuous >= 1.5, (
+        f"continuous {continuous * 1e3:.2f}ms vs round "
+        f"{round_based * 1e3:.2f}ms — ratio "
+        f"{round_based / continuous:.2f} < 1.5"
+    )
 
 
 # -- CLI end-to-end (the acceptance criterion) ------------------------------

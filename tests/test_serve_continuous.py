@@ -1,0 +1,274 @@
+"""Continuous in-flight batching tests (SERVE_CONTINUOUS_BATCHING=1).
+
+Token identity is the contract — every row the slot engine serves must
+emit EXACTLY the tokens solo greedy decode emits (fp32 and int8 KV,
+cold and warm-prefix, including a request admitted mid-decode while
+other rows hold their slots). Alongside identity: the engine's
+observability surface (slot-occupancy gauge, admission-wait histogram,
+recycled counter, /healthz engine stats) and the config gating
+(mesh/MoE warn-and-fall-back, prompt-lookup exclusivity).
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from tpu_kubernetes.serve.server import (
+    ADMISSION_WAIT,
+    SLOT_OCCUPANCY,
+    SLOTS_RECYCLED,
+    ServingState,
+    _Batcher,
+    make_server,
+)
+
+ENV = {
+    "SERVE_MODEL": "llama-test",
+    "SERVE_MAX_NEW": "16",
+    "SERVE_DTYPE": "float32",    # bf16 ties can break exact-id comparisons
+}
+
+# distinct prompts at different lengths, so slot rows sit at different
+# width buckets and positions — the mixed batch the engine exists for
+PROMPTS = [
+    "the quick brown fox jumps over the lazy dog",   # bucket 64
+    "pack my box",                                   # bucket 16
+    "sphinx of black quartz judge my vow",           # bucket 64
+    "jived fox nymph grabs quick waltz",             # bucket 64
+]
+BUDGETS = [12, 3, 5, 8]
+
+
+def _state(**extra) -> ServingState:
+    st = ServingState(dict(ENV, **extra))
+    st.warm()
+    return st
+
+
+@pytest.fixture(scope="module")
+def solo_state():
+    """Engine off, early exit off — the run-to-max solo reference."""
+    return _state(SERVE_EARLY_EXIT_STEPS="0")
+
+
+@pytest.fixture(scope="module")
+def cont_state():
+    """The continuous engine: 4 slots, default K=8 segments."""
+    return _state(SERVE_CONTINUOUS_BATCHING="1", SERVER_BATCH="4")
+
+
+def _settle(pred, timeout=10.0):
+    """Wait out the scheduler thread's tail: a row's event fires before
+    its slot is cleared, so counter/gauge assertions poll briefly."""
+    deadline = time.monotonic() + timeout
+    while not pred() and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert pred()
+
+
+def _fan_out(state, prompts, budgets):
+    """Submit every request from its own thread — the engine serves
+    them as one mixed, staggered batch."""
+    outs: list[dict | None] = [None] * len(prompts)
+
+    def worker(i):
+        outs[i] = state.complete(prompts[i], max_new_tokens=budgets[i])
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(len(prompts))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert all(o is not None for o in outs)
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# token identity: continuous rows vs solo greedy decode
+# ---------------------------------------------------------------------------
+
+
+def test_continuous_identity_with_solo_greedy(solo_state, cont_state):
+    """Four concurrent staggered-budget requests through the engine
+    must match the solo server token-for-token — different widths,
+    different budgets, slots recycling as short rows drain."""
+    refs = [
+        solo_state.complete(p, max_new_tokens=b)
+        for p, b in zip(PROMPTS, BUDGETS)
+    ]
+    r0 = SLOTS_RECYCLED.value
+    outs = _fan_out(cont_state, PROMPTS, BUDGETS)
+    for out, ref in zip(outs, refs):
+        assert out["text"] == ref["text"]
+        assert out["tokens"] == ref["tokens"]          # emitted count
+    _settle(lambda: SLOTS_RECYCLED.value >= r0 + len(PROMPTS))
+
+
+def test_continuous_identity_int8_kv_quant():
+    """Same contract with the quantized (int8 + scales) KV cache: the
+    insert grafts k/v AND the per-slot scales, so engine rows decode
+    exactly like solo int8 rows."""
+    kv_solo = _state(SERVE_KV_QUANT="1", SERVE_EARLY_EXIT_STEPS="0")
+    kv_cont = _state(SERVE_KV_QUANT="1", SERVE_CONTINUOUS_BATCHING="1",
+                     SERVER_BATCH="4")
+    refs = [
+        kv_solo.complete(p, max_new_tokens=b)
+        for p, b in zip(PROMPTS, BUDGETS)
+    ]
+    outs = _fan_out(kv_cont, PROMPTS, BUDGETS)
+    for out, ref in zip(outs, refs):
+        assert out["text"] == ref["text"]
+
+
+def test_continuous_identity_warm_prefix(solo_state):
+    """A prefix-cache hit lands in a slot through the same
+    _prefill_any policy point as a cold prefill — warm engine rows
+    must match the cache-free solo server."""
+    warm = _state(SERVE_CONTINUOUS_BATCHING="1", SERVER_BATCH="4",
+                  SERVE_PREFIX_CACHE_MB="8")
+    ref = solo_state.complete(PROMPTS[0], max_new_tokens=8)
+
+    first = warm.complete(PROMPTS[0], max_new_tokens=8)   # cold + insert
+    assert first["text"] == ref["text"]
+    assert warm.prefix_cache.stats()["entries"] >= 1
+
+    again = warm.complete(PROMPTS[0], max_new_tokens=8)   # prefix hit
+    assert again["text"] == ref["text"]
+
+    # warm and cold rows co-resident in one mixed batch
+    outs = _fan_out(warm, PROMPTS, BUDGETS)
+    refs = [
+        solo_state.complete(p, max_new_tokens=b)
+        for p, b in zip(PROMPTS, BUDGETS)
+    ]
+    for out, r in zip(outs, refs):
+        assert out["text"] == r["text"]
+
+
+def test_continuous_identity_mid_stream_admission(solo_state, cont_state):
+    """A request admitted while another row is mid-decode (its slot
+    position already advanced past its prompt) must not perturb the
+    resident row, and must itself decode token-identically."""
+    eng = cont_state._engine
+    ids_long = cont_state.encode(PROMPTS[0])
+    ids_late = cont_state.encode(PROMPTS[1])
+    ref_long = solo_state.complete(PROMPTS[0], max_new_tokens=16)
+    ref_late = solo_state.complete(PROMPTS[1], max_new_tokens=4)
+
+    e1 = eng.enqueue(ids_long, 16)
+    assert e1["dispatched"].wait(30)          # resident in a slot
+    # wait for its first segment: pos advances past the prompt bucket
+    slot = eng._entries.index(e1)
+    deadline = time.monotonic() + 30
+    while (eng._pos[slot] <= eng._ps[slot]
+           and e1 in eng._entries
+           and time.monotonic() < deadline):
+        time.sleep(0.001)
+    e2 = eng.enqueue(ids_late, 4)             # admitted mid-decode
+    assert e1["event"].wait(60) and e2["event"].wait(60)
+    # raw engine rows, trimmed by the budget rule complete() applies
+    assert (cont_state.decode_text(_Batcher.result(e1)[:16])
+            == ref_long["text"])
+    assert (cont_state.decode_text(_Batcher.result(e2)[:4])
+            == ref_late["text"])
+
+
+# ---------------------------------------------------------------------------
+# observability: gauge/histogram/counter, /healthz engine stats
+# ---------------------------------------------------------------------------
+
+
+def test_engine_metrics_and_stats(cont_state):
+    c0 = ADMISSION_WAIT._solo().count
+    r0 = SLOTS_RECYCLED.value
+    _fan_out(cont_state, PROMPTS[:2], [4, 4])
+    # every admitted request observed its enqueue → insert wait
+    assert ADMISSION_WAIT._solo().count >= c0 + 2
+    _settle(lambda: SLOTS_RECYCLED.value >= r0 + 2)
+    _settle(lambda: cont_state._engine.stats()["occupied"] == 0)
+    stats = cont_state._engine.stats()
+    assert stats["slots"] == 4
+    assert stats["segment_steps"] == 8
+    # per-engine tally (the counter is process-global across engines)
+    assert stats["recycled"] >= 2
+    assert stats["queued"] == 0
+    # all rows drained → the gauge's last write is an empty batch
+    _settle(lambda: SLOT_OCCUPANCY.value == 0)
+
+
+@pytest.fixture(scope="module")
+def continuous_server():
+    srv = make_server(dict(
+        ENV, SERVER_HOST="127.0.0.1", SERVER_PORT="0",
+        SERVE_CONTINUOUS_BATCHING="1", SERVER_BATCH="4",
+    ))
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv
+    srv.shutdown()
+
+
+def _request(server, method, path, body=None):
+    host, port = server.server_address[:2]
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request(
+        method, path,
+        body=None if body is None else json.dumps(body),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def test_http_surfaces_engine_metrics_and_healthz(continuous_server):
+    req = {"prompt": PROMPTS[0], "max_new_tokens": 4}
+    status, body = _request(continuous_server, "POST",
+                            "/v1/completions", req)
+    assert status == 200 and json.loads(body)["text"]
+
+    status, body = _request(continuous_server, "GET", "/metrics")
+    text = body.decode()
+    assert status == 200
+    assert "# TYPE tpu_serve_slot_occupancy gauge" in text
+    assert "# TYPE tpu_serve_admission_wait_seconds histogram" in text
+    assert "# TYPE tpu_serve_slots_recycled_total counter" in text
+
+    def engine_drained():
+        status, body = _request(continuous_server, "GET", "/healthz")
+        assert status == 200
+        cb = json.loads(body)["continuous_batching"]
+        assert cb["slots"] == 4
+        return cb["recycled"] >= 1 and cb["occupied"] == 0
+
+    _settle(engine_drained)
+
+
+# ---------------------------------------------------------------------------
+# config gating: fall-backs and exclusivity
+# ---------------------------------------------------------------------------
+
+
+def test_continuous_falls_back_for_moe():
+    """MoE expert capacity is batch-shaped (a co-rider could change a
+    response) — the engine must warn-and-fall-back, not build."""
+    st = ServingState(dict(
+        ENV, SERVE_MODEL="moe-test", SERVE_CONTINUOUS_BATCHING="1",
+        SERVER_BATCH="4",
+    ))
+    assert st._engine is None
+    assert st._batcher is None                # MoE skips the batcher too
+
+
+def test_continuous_rejects_prompt_lookup():
+    with pytest.raises(ValueError, match="exclusive"):
+        ServingState(dict(
+            ENV, SERVE_CONTINUOUS_BATCHING="1", SERVE_PROMPT_LOOKUP="1",
+        ))

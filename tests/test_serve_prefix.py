@@ -250,6 +250,25 @@ def test_batcher_requeues_overflow_at_front_in_arrival_order():
     assert order == [[0], [1], [2], [3]]
 
 
+def test_batcher_wakes_when_full_before_window():
+    """A full batch must dispatch IMMEDIATELY — with window_ms at 10
+    seconds, entries only complete fast if the dispatcher wakes on the
+    max_batch-th enqueue instead of sleeping out the window."""
+    import time
+
+    def run_batch(entries):
+        for e in entries:
+            e["tokens"] = []
+
+    b = _Batcher(run_batch, max_batch=2, window_ms=10_000)
+    t0 = time.monotonic()
+    entries = [b.enqueue([i], 1) for i in range(2)]
+    for e in entries:
+        assert e["event"].wait(5), "dispatcher slept the full window"
+        assert e["error"] is None
+    assert time.monotonic() - t0 < 5
+
+
 def test_batcher_clean_rounds_do_not_taint():
     """Sanity guard for the counter itself: a healthy dispatch round
     must not bump the taint counter."""

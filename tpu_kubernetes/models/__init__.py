@@ -16,11 +16,17 @@ from tpu_kubernetes.models import llama as _llama
 from tpu_kubernetes.models import moe as _moe
 from tpu_kubernetes.models.decode import (  # noqa: F401
     KVCache,
+    SlotState,
+    cache_clear_row,
+    cache_insert_row,
     decode_chunk,
     decode_segment,
+    decode_segment_slots,
     decode_step,
+    decode_step_slots,
     generate,
     init_cache,
+    init_slot_state,
     prefill,
     prefill_chunked,
     prefill_resume,

@@ -161,10 +161,15 @@ def _attend_cache(cfg, q, k_cache, v_cache, limits,
     mask = slots < limits[..., None]                # (c, S) | (b, c, S)
     if prompt_lengths is not None:
         # ragged chunks: limits (b, c), mask (b, c, S) — c=1 is the
-        # classic decode step, c>1 is chunk verification
+        # classic decode step, c>1 is chunk verification.
+        # prompt_slots is a scalar for uniform batches (all rows'
+        # generation region starts at one padded width) or (b,) for
+        # slot-mixed batches (decode_step_slots), where every row keeps
+        # its own prompt width; reshape(-1, 1) broadcasts both.
+        ps = jnp.asarray(prompt_slots).reshape(-1, 1)
         real = (
             (slots[None, :] < prompt_lengths[:, None])
-            | (slots[None, :] >= prompt_slots)
+            | (slots[None, :] >= ps)
         )                                           # (b, S)
         mask = mask & real[:, None, :]
     if mask.ndim == 2:                              # shared across batch
@@ -667,3 +672,225 @@ def decode_segment(
         step, (cache, token, done), None, length=steps
     )
     return toks.T, token, done, cache
+
+
+# ---------------------------------------------------------------------------
+# continuous batching: per-slot decode over a mixed batch
+# ---------------------------------------------------------------------------
+
+
+class SlotState(NamedTuple):
+    """Per-slot decode carry for CONTINUOUS batching: every row of the
+    batch cache is an independent request at its own position, admitted
+    and retired without stopping the others. All fields are (batch,)
+    int32; a slot is LIVE iff ``remaining > 0`` (EOS and budget
+    exhaustion both zero it, folding done/live/budget into one field —
+    the host reads one array between segments to drain finished slots).
+
+    * ``tok``        — the row's previously emitted token (the decode
+                       step's input, mirroring :func:`decode_segment`'s
+                       raw-token carry);
+    * ``pos``        — the cache SLOT the next K/V write lands in (its
+                       logical RoPE position is ``prompt_lengths +
+                       (pos - prompt_slots)`` — gapless per row, the
+                       same rule ragged batches use);
+    * ``remaining``  — tokens this row may still emit (its budget);
+    * ``prompt_lengths`` / ``prompt_slots`` — the per-ROW ragged prompt
+      metadata (unlike :class:`KVCache`, where ``prompt_slots`` is a
+      batch-wide scalar: slot rows were prefilled at different widths).
+
+    Retired slots keep decoding (static shapes): a dead row re-writes
+    its own unused slot ``pos`` (never advanced once ``remaining`` hits
+    0; always in range — the last live write was at ``pos - 1`` and
+    admission required ``prompt_slots + budget <= max_seq``) and its
+    attention reads only its own row, so garbage never crosses rows —
+    the same independence argument ragged batching rests on (dense
+    models only; MoE capacity is batch-shaped)."""
+
+    tok: jax.Array
+    pos: jax.Array
+    remaining: jax.Array
+    prompt_lengths: jax.Array
+    prompt_slots: jax.Array
+
+
+def init_slot_state(batch: int) -> SlotState:
+    """All slots free: remaining=0 everywhere. Empty slots attend only
+    their own zero-initialized row (pos=0 → one masked-in slot), so
+    they are numerically inert until an insert claims them."""
+    z = jnp.zeros((batch,), jnp.int32)
+    return SlotState(tok=z, pos=z, remaining=z,
+                     prompt_lengths=z, prompt_slots=z)
+
+
+def cache_insert_row(cache: KVCache, row: KVCache, slot) -> KVCache:
+    """Graft a freshly prefilled SINGLE-row cache segment into row
+    ``slot`` of a fixed (max_batch, max_seq) batch cache — the admission
+    primitive of continuous batching. ``row`` is what :func:`prefill`
+    (or :func:`prefill_resume`) returns for one request at its own
+    bucketed width; its k/v are padded out to the engine's max_seq with
+    init_cache values (zeros; scale 1.0) so an occupied slot differs
+    from a cold one only in its real positions. ``slot`` may be traced
+    — jit once per row width, donate the batch cache (argnum 0) and XLA
+    updates it in place."""
+    S = cache.k.shape[3]
+    s_row = row.k.shape[3]
+    if s_row > S:
+        raise ValueError(
+            f"row cache width {s_row} exceeds engine max_seq {S}"
+        )
+    if row.k.shape[1] != 1:
+        raise ValueError(f"row cache must be batch-1, got {row.k.shape[1]}")
+    if (cache.k_scale is None) != (row.k_scale is None):
+        raise ValueError(
+            "cache/row kv-quant mismatch (one has int8 scales)"
+        )
+
+    def graft(dst, src, fill):
+        pad = [(0, 0)] * src.ndim
+        pad[3] = (0, S - s_row)
+        src = jnp.pad(src, pad, constant_values=fill).astype(dst.dtype)
+        start = (0, slot) + (0,) * (src.ndim - 2)
+        return jax.lax.dynamic_update_slice(dst, src, start)
+
+    return cache._replace(
+        k=graft(cache.k, row.k, 0),
+        v=graft(cache.v, row.v, 0),
+        k_scale=(None if cache.k_scale is None
+                 else graft(cache.k_scale, row.k_scale, 1.0)),
+        v_scale=(None if cache.v_scale is None
+                 else graft(cache.v_scale, row.v_scale, 1.0)),
+    )
+
+
+def cache_clear_row(cache: KVCache, slot) -> KVCache:
+    """Reset row ``slot`` to init_cache values (zeros; scale 1.0) —
+    slot recycling after a request drains. Not needed for correctness
+    (a retired row's garbage is masked and rows are independent) but
+    keeps freed slots bitwise equal to a cold cache, so an engine
+    restart and a long-running engine see identical state. Same
+    jit/donate discipline as :func:`cache_insert_row`."""
+    def wipe(dst, fill):
+        shape = dst.shape[:1] + (1,) + dst.shape[2:]
+        src = jnp.full(shape, fill, dst.dtype)
+        start = (0, slot) + (0,) * (dst.ndim - 2)
+        return jax.lax.dynamic_update_slice(dst, src, start)
+
+    return cache._replace(
+        k=wipe(cache.k, 0),
+        v=wipe(cache.v, 0),
+        k_scale=(None if cache.k_scale is None
+                 else wipe(cache.k_scale, 1.0)),
+        v_scale=(None if cache.v_scale is None
+                 else wipe(cache.v_scale, 1.0)),
+    )
+
+
+def decode_step_slots(
+    params: dict, cache: KVCache, st: SlotState, cfg: ModelConfig
+) -> tuple[jax.Array, KVCache]:
+    """One greedy-decode forward where EVERY row sits at its own
+    position ``st.pos`` — the mixed-batch analog of :func:`decode_step`
+    (which advances the whole batch at one shared ``cache.length``).
+    K/V writes are per-row scatters at (row, st.pos[row]); attention
+    masks each row to its own real span (prompt ∪ generated, the ragged
+    rule with per-row ``prompt_slots``); RoPE positions are gapless per
+    row. → (logits (b, vocab) f32, cache with every row's slot
+    written). The cache's scalar ``length``/batch-wide metadata are
+    ignored — SlotState IS the position authority here."""
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    b = st.tok.shape[0]
+    h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    positions = (
+        st.prompt_lengths + (st.pos - st.prompt_slots)
+    )[:, None]                                               # (b, 1)
+    limits = (st.pos + 1)[:, None]                           # (b, 1)
+    b_idx = jnp.arange(b)[:, None]
+    kv_idx = jnp.arange(kv)[None, :]
+    x = params["embed"][st.tok][:, None, :]                  # (b, 1, d)
+
+    def block(carry, xs):
+        x, (k_all, v_all, ks_all, vs_all) = carry
+        layer, li = xs
+        y = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = (y @ _w(layer["wq"], cfg.dtype)).reshape(b, 1, h, hd)
+        q = q.transpose(0, 2, 1, 3)
+        k = (y @ _w(layer["wk"], cfg.dtype)).reshape(b, 1, kv, hd)
+        k = k.transpose(0, 2, 1, 3)
+        v = (y @ _w(layer["wv"], cfg.dtype)).reshape(b, 1, kv, hd)
+        v = v.transpose(0, 2, 1, 3)
+        q = apply_rope(q, cos, sin, positions=positions)
+        k = apply_rope(k, cos, sin, positions=positions)
+        k1, v1 = k[:, :, 0, :], v[:, :, 0, :]                # (b, kv, hd)
+        if ks_all is not None:
+            k1, k_sc = _quantize_kv(k1)
+            v1, v_sc = _quantize_kv(v1)
+            ks_all = ks_all.at[li, b_idx, kv_idx, st.pos[:, None]].set(k_sc)
+            vs_all = vs_all.at[li, b_idx, kv_idx, st.pos[:, None]].set(v_sc)
+        k1 = k1.astype(k_all.dtype)
+        v1 = v1.astype(v_all.dtype)
+        k_all = k_all.at[li, b_idx, kv_idx, st.pos[:, None]].set(k1)
+        v_all = v_all.at[li, b_idx, kv_idx, st.pos[:, None]].set(v1)
+        k_cache = jax.lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)
+        v_cache = jax.lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)
+        k_scale = v_scale = None
+        if ks_all is not None:
+            k_scale = jax.lax.dynamic_index_in_dim(
+                ks_all, li, 0, keepdims=False)
+            v_scale = jax.lax.dynamic_index_in_dim(
+                vs_all, li, 0, keepdims=False)
+        attn = _attend_cache(cfg, q, k_cache, v_cache, limits,
+                             st.prompt_lengths, st.prompt_slots,
+                             k_scale=k_scale, v_scale=v_scale)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, h * hd)
+        x = x + attn @ _w(layer["wo"], cfg.dtype)
+        return (_mlp(cfg, x, layer), (k_all, v_all, ks_all, vs_all)), None
+
+    n_layers = cache.k.shape[0]
+    (x, (k_new, v_new, ks_new, vs_new)), _ = jax.lax.scan(
+        block,
+        (x, (cache.k, cache.v, cache.k_scale, cache.v_scale)),
+        (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
+    )
+    x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
+    logits = (x @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
+    return logits, cache._replace(
+        k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new,
+    )
+
+
+def decode_segment_slots(
+    params: dict, cache: KVCache, st: SlotState, cfg: ModelConfig,
+    steps: int, *, eos_id: int | None = None, pad_id: int = 0,
+) -> tuple[jax.Array, SlotState, KVCache]:
+    """``steps`` greedy :func:`decode_step_slots` steps — the
+    continuous-batching analog of :func:`decode_segment`: the host runs
+    one segment per K steps, reads back ``remaining``, drains finished
+    slots and inserts queued requests between segments. A live row
+    (remaining > 0) emits its greedy token, advances ``pos``, and burns
+    one unit of budget (EOS zeroes the rest); a dead row emits
+    ``pad_id`` and freezes. Token emission matches
+    :func:`decode_segment` exactly — liveness decides when a row STOPS,
+    never what it emits. → (emitted (batch, steps) int32, state,
+    cache)."""
+
+    def step(carry, _):
+        cache, st = carry
+        live = st.remaining > 0
+        logits, cache = decode_step_slots(params, cache, st, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        emitted = jnp.where(live, nxt, pad_id)
+        rem = jnp.where(live, st.remaining - 1, 0)
+        if eos_id is not None:
+            rem = jnp.where(live & (nxt == eos_id), 0, rem)
+        st = st._replace(
+            tok=jnp.where(live, nxt, st.tok),
+            pos=jnp.where(live, st.pos + 1, st.pos),
+            remaining=rem,
+        )
+        return (cache, st), emitted
+
+    (cache, st), toks = jax.lax.scan(
+        step, (cache, st), None, length=steps
+    )
+    return toks.T, st, cache

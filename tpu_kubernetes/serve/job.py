@@ -62,10 +62,13 @@ whole pipeline is env-driven like the trainer:
 The live HTTP server (serve/server.py) consumes this same contract via
 ``load_serving_stack`` and layers its serving-only knobs on top —
 SERVER_HOST/SERVER_PORT, SERVER_BATCH/SERVER_BATCH_WINDOW_MS,
-SERVE_PREFIX_CACHE_MB (bounded-LRU prefix KV-cache reuse) and
-SERVE_EARLY_EXIT_STEPS (early-exit decode liveness interval) — all
-documented there; the batch job runs one fused program per batch, so
-per-request caching/early-exit does not apply here.
+SERVE_PREFIX_CACHE_MB (bounded-LRU prefix KV-cache reuse),
+SERVE_EARLY_EXIT_STEPS (early-exit decode liveness interval) and
+SERVE_CONTINUOUS_BATCHING (persistent slot-engine decode: requests are
+admitted into the running batch between segments, SERVER_BATCH doubling
+as the slot count) — all documented there; the batch job runs one fused
+program per batch, so per-request caching/early-exit/slot scheduling
+does not apply here.
 
 The reference provisioner has no inference plane (SURVEY §0); this
 completes the in-tree stack's serving story end to end (provision →

@@ -52,7 +52,13 @@ SERVE_PREFIX_CACHE_MB (> 0 enables the prefix KV-cache: requests whose
 prompts share a token prefix with earlier traffic prefill only the
 suffix; bounded LRU, bytes gauge + hit/partial/miss counter),
 SERVE_EARLY_EXIT_STEPS (the greedy decode loop's host-side liveness
-check interval — finished rows stop costing decode steps),
+check interval — finished rows stop costing decode steps; doubles as
+the continuous engine's segment length),
+SERVE_CONTINUOUS_BATCHING (=1: greedy default requests serve through a
+persistent slot-based decode engine instead of round-based batching —
+new requests are admitted into the running batch as finished rows
+drain, per-row width buckets, SERVER_BATCH doubles as the slot count;
+dense single-device only, warn-and-fall-back otherwise),
 SERVE_MESH (e.g. ``tensor=4``) — tensor-sharded fused generation over
 this host's chips, so models bigger than one chip's HBM serve live
 (streaming and prompt-lookup stay single-device and say so) — and
@@ -203,6 +209,23 @@ BATCH_TAINT = REGISTRY.counter(
     "dispatcher selection failures that tainted a whole pending round "
     "(every selected entry fails out; submit() never hangs)",
 )
+SLOT_OCCUPANCY = REGISTRY.gauge(
+    "tpu_serve_slot_occupancy",
+    "continuous batching: slots holding a live request (out of the "
+    "engine's fixed slot count — sustained saturation means add slots "
+    "or replicas)",
+)
+ADMISSION_WAIT = REGISTRY.histogram(
+    "tpu_serve_admission_wait_seconds",
+    "continuous batching: enqueue to slot-insert wait (how long a "
+    "request waited for a free slot + its prefill)",
+    buckets=_LATENCY_BUCKETS,
+)
+SLOTS_RECYCLED = REGISTRY.counter(
+    "tpu_serve_slots_recycled_total",
+    "continuous batching: finished rows drained and their slots freed "
+    "for the next queued request",
+)
 # device-synced phase attribution (obs/profile.py): prefill / decode /
 # fused-generate device seconds split by mode — "compile" is a program's
 # first call (jit trace + XLA compile ride on it), "execute" is steady
@@ -321,8 +344,16 @@ class _Batcher:
             with self._cond:
                 while not self._queue:
                     self._cond.wait()
-            time.sleep(self.window_s)      # let co-riders arrive
-            with self._cond:
+                # let co-riders arrive — but wake EARLY the moment a
+                # full batch is queued (sleeping out the rest of the
+                # window with max_batch entries already waiting would
+                # be pure added latency); enqueue() notifies per entry
+                deadline = time.monotonic() + self.window_s
+                while len(self._queue) < self.max_batch:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cond.wait(timeout=left)
                 pending, self._queue = self._queue, []
             batch: list[dict] = []
             rest: list[dict] = []
@@ -369,6 +400,279 @@ class _Batcher:
                 # straight back to the queue check
                 with self._cond:
                     self._queue = rest + self._queue
+
+
+class _ContinuousEngine:
+    """Continuous in-flight batching: a persistent slot-based decode
+    engine (SERVE_CONTINUOUS_BATCHING=1) replacing the round-based
+    _Batcher for greedy default-sampling requests.
+
+    One scheduler thread owns a fixed (slots, max_seq) batch cache.
+    Between K-step ``decode_segment_slots`` programs it drains finished
+    slots (fanning results back to their waiting handlers), pulls
+    queued requests, prefills each at its OWN power-of-two width bucket
+    (warm-prefix resume included — ``_prefill_any`` is the shared
+    policy point, so a prefix hit lands in a slot exactly like a cold
+    prefill), and grafts the row into a free slot with a jitted,
+    donated ``cache_insert_row``. A request arriving mid-decode waits
+    at most one segment for admission instead of a whole stranger
+    round, and a finished row's slot is recycled immediately instead of
+    riding dead to the round's end. Width bucketing is per-ROW: one
+    long prompt no longer inflates every co-rider's width.
+
+    The program set stays O(log max_seq): per-width prefill programs
+    (shared with solo serving), ONE insert program, ONE clear program,
+    ONE segment program (the batch shape is fixed). Entries share the
+    _Batcher dict shape so complete() consumes both identically, and
+    per-row decode is token-identical to solo greedy (models/decode.py
+    SlotState — the ragged-row independence argument, which is also why
+    MoE serves round-based instead: its expert capacity is
+    batch-shaped). The generation lock is taken per prefill/segment and
+    released between, so solo/streaming/sampled requests interleave
+    with a busy engine."""
+
+    def __init__(self, state: "ServingState", slots: int, seg_steps: int):
+        import numpy as np
+
+        from tpu_kubernetes.models.decode import init_cache
+
+        self._state = state
+        self.slots = slots
+        self.seg_steps = max(1, seg_steps)
+        self.span = state.cfg.max_seq
+        self._cond = threading.Condition()
+        self._queue: list[dict] = []
+        # host-side slot table: _entries[i] is the request occupying
+        # slot i (None = free); the int32 arrays mirror SlotState and
+        # are owned by the scheduler thread (other threads only read
+        # them for one-glance stats)
+        self._entries: list[dict | None] = [None] * slots
+        self._collected: list[list[int]] = [[] for _ in range(slots)]
+        self._pos = np.zeros(slots, np.int32)
+        self._tok = np.zeros(slots, np.int32)
+        self._rem = np.zeros(slots, np.int32)
+        self._pl = np.zeros(slots, np.int32)
+        self._ps = np.zeros(slots, np.int32)
+        self.recycled = 0
+        self._cache = init_cache(
+            state.cfg, slots, self.span, kv_quant=state.kv_quant
+        )
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def enqueue(self, ids: list, max_new: int) -> dict:
+        """Queue a request; same entry contract as _Batcher.enqueue
+        (``dispatched`` fires at slot insert — the end of the admission
+        wait — ``event`` when the row's tokens are ready), so
+        complete() consumes engine and batcher entries through one
+        code path (_Batcher.result)."""
+        entry = {
+            "ids": ids, "max_new": max_new, "t_enq": time.monotonic(),
+            "budget": max_new,
+            "event": threading.Event(), "dispatched": threading.Event(),
+            "tokens": None, "error": None,
+        }
+        with self._cond:
+            self._queue.append(entry)
+            self._cond.notify()
+        return entry
+
+    def stats(self) -> dict:
+        """One-glance engine state for /healthz (the gauges/counters
+        ride /metrics)."""
+        with self._cond:
+            queued = len(self._queue)
+        return {
+            "slots": self.slots,
+            "occupied": sum(e is not None for e in self._entries),
+            "queued": queued,
+            "segment_steps": self.seg_steps,
+            "recycled": self.recycled,
+        }
+
+    # -- scheduler thread ---------------------------------------------------
+
+    def _loop(self) -> None:
+        # the loop body may never raise: a dead scheduler would hang
+        # every future submitter, so any failure fails the affected
+        # entries out and resets the engine cold (the _Batcher stance)
+        while True:
+            with self._cond:
+                while not self._queue and all(
+                    e is None for e in self._entries
+                ):
+                    self._cond.wait()
+            try:
+                self._admit()
+                self._run_segment()
+            except Exception as e:  # noqa: BLE001 — surfaced per entry
+                self._fail_out(e)
+
+    def _admit(self) -> None:
+        """Fill free slots from the queue (FIFO). Per-entry failures
+        (a bad prefill) fail that entry out; the engine keeps serving."""
+        while True:
+            free = next(
+                (i for i, e in enumerate(self._entries) if e is None),
+                None,
+            )
+            if free is None:
+                return
+            with self._cond:
+                if not self._queue:
+                    return
+                entry = self._queue.pop(0)
+            try:
+                self._insert(entry, free)
+            except Exception as e:  # noqa: BLE001 — this entry only
+                entry["error"] = e
+                entry["dispatched"].set()
+                entry["event"].set()
+
+    def _insert(self, entry: dict, slot: int) -> None:
+        import numpy as np
+
+        from tpu_kubernetes.models.decode import cache_insert_row
+
+        st = self._state
+        jax = st._jax
+        ids, budget = entry["ids"], entry["budget"]
+        width = _bucket(len(ids))
+        with st._lock:
+            # per-row width bucket; span == width (zero generation
+            # slots — decode happens in the engine cache, not the row
+            # cache), so prefill programs are shared with solo serving
+            # and the prefix store serves warm starts into slots too
+            logits, row = st._prefill_any(ids, width, width)
+            first = int(np.argmax(np.asarray(logits)[0]))
+            if budget <= 1 or (st.eos_id is not None
+                               and first == st.eos_id):
+                # one-token budget or instant EOS: done without a slot
+                entry["tokens"] = [first]
+            else:
+                ins = st._cached_program(
+                    ("slot_insert",),
+                    lambda: jax.jit(
+                        cache_insert_row, donate_argnums=(0,)
+                    ),
+                )
+                self._cache = ins(self._cache, row, slot)
+        if entry["tokens"] is not None:
+            entry["dispatched"].set()
+            entry["event"].set()
+            ADMISSION_WAIT.observe(time.monotonic() - entry["t_enq"])
+            return
+        self._entries[slot] = entry
+        self._collected[slot] = [first]
+        self._pos[slot] = width
+        self._tok[slot] = first
+        self._rem[slot] = budget - 1     # the first token is emitted
+        self._pl[slot] = len(ids)
+        self._ps[slot] = width
+        entry["dispatched"].set()
+        ADMISSION_WAIT.observe(time.monotonic() - entry["t_enq"])
+        SLOT_OCCUPANCY.set(sum(e is not None for e in self._entries))
+
+    def _run_segment(self) -> None:
+        """One K-step mixed-batch segment, then drain finished rows.
+        The lock is held only for the segment itself, so other request
+        modes interleave between segments."""
+        import functools
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_kubernetes.models.decode import (
+            SlotState,
+            decode_segment_slots,
+        )
+
+        st = self._state
+        jax = st._jax
+        if all(e is None for e in self._entries):
+            return
+        steps = self.seg_steps
+        seg = st._cached_program(
+            ("slot_segment", steps),
+            lambda: jax.jit(functools.partial(
+                decode_segment_slots, cfg=st.cfg, steps=steps,
+                eos_id=st.eos_id, pad_id=0,
+            ), donate_argnums=(1,)),
+        )
+        state = SlotState(
+            tok=jnp.asarray(self._tok), pos=jnp.asarray(self._pos),
+            remaining=jnp.asarray(self._rem),
+            prompt_lengths=jnp.asarray(self._pl),
+            prompt_slots=jnp.asarray(self._ps),
+        )
+        with st._lock:
+            with PROFILER.phase(
+                "decode", key=("slot_segment", steps), tracer=TRACER,
+            ) as pd:
+                toks, state, self._cache = pd.sync(
+                    seg(st.params, self._cache, state)
+                )
+        toks = np.asarray(toks)
+        new_pos = np.asarray(state.pos)
+        old_pos, self._pos = self._pos, new_pos.copy()
+        self._tok = np.asarray(state.tok).copy()
+        self._rem = np.asarray(state.remaining).copy()
+        for i, entry in enumerate(self._entries):
+            if entry is None:
+                continue
+            # a row emitted exactly as many tokens as its pos advanced
+            # (frozen rows never advance) — pads never reach results
+            emitted = int(new_pos[i] - old_pos[i])
+            self._collected[i].extend(toks[i][:emitted].tolist())
+            if self._rem[i] <= 0:
+                entry["tokens"] = self._collected[i]
+                entry["event"].set()
+                self._retire(i)
+        SLOT_OCCUPANCY.set(sum(e is not None for e in self._entries))
+
+    def _retire(self, slot: int) -> None:
+        from tpu_kubernetes.models.decode import cache_clear_row
+
+        st = self._state
+        jax = st._jax
+        clr = st._cached_program(
+            ("slot_clear",),
+            lambda: jax.jit(cache_clear_row, donate_argnums=(0,)),
+        )
+        with st._lock:
+            self._cache = clr(self._cache, slot)
+        self._entries[slot] = None
+        self._collected[slot] = []
+        self._pos[slot] = self._tok[slot] = self._rem[slot] = 0
+        self._pl[slot] = self._ps[slot] = 0
+        self.recycled += 1
+        SLOTS_RECYCLED.inc()
+
+    def _fail_out(self, err: Exception) -> None:
+        """A scheduler-level failure fails every queued AND resident
+        entry out (no submitter may hang) and resets the engine cold."""
+        from tpu_kubernetes.models.decode import init_cache
+
+        log.warn(
+            f"continuous engine reset: {type(err).__name__}: {err}"
+        )
+        with self._cond:
+            queued, self._queue = self._queue, []
+        affected = queued + [e for e in self._entries if e is not None]
+        for i in range(self.slots):
+            self._entries[i] = None
+            self._collected[i] = []
+        for a in (self._pos, self._tok, self._rem, self._pl, self._ps):
+            a[:] = 0
+        st = self._state
+        self._cache = init_cache(
+            st.cfg, self.slots, self.span, kv_quant=st.kv_quant
+        )
+        for e in affected:
+            e["error"] = err
+            e["dispatched"].set()
+            e["event"].set()
+        SLOT_OCCUPANCY.set(0)
 
 
 class ServingState:
@@ -463,7 +767,33 @@ class ServingState:
         self._programs_lock = threading.Lock()
         batch = int(env.get("SERVER_BATCH", "1"))
         self._batcher = None
+        self._engine = None
         from tpu_kubernetes.models import MoEConfig
+
+        # SERVE_CONTINUOUS_BATCHING=1: replace the round-based batcher
+        # with the persistent slot engine (_ContinuousEngine) for greedy
+        # default requests. SERVER_BATCH doubles as the slot count
+        # (default 4 when unset/1 — slots are decode-batch rows, so the
+        # same sizing intuition applies). Dense single-device only, the
+        # prefix cache's warn-and-fall-back pattern: sharded serving is
+        # fused (no incremental decode to admit into) and MoE capacity
+        # is batch-shaped (a co-rider could change a response).
+        continuous = truthy_env(env, "SERVE_CONTINUOUS_BATCHING")
+        if continuous and self.prompt_lookup:
+            raise ValueError(
+                "SERVE_CONTINUOUS_BATCHING and SERVE_PROMPT_LOOKUP are "
+                "exclusive owners of the greedy path (speculation is "
+                "batch-1; the engine is a persistent batch) — pick one"
+            )
+        if continuous and (self.mesh is not None
+                           or isinstance(cfg, MoEConfig)):
+            log.warn(
+                "SERVE_CONTINUOUS_BATCHING ignored: the slot engine "
+                "needs a single-device dense model (sharded serving is "
+                "fused; MoE capacity is batch-width-dependent)"
+            )
+            continuous = False
+        self._continuous = continuous
 
         if self.prompt_lookup:
             # mirror the batch job's loud config rejections (serve/job.py)
@@ -496,7 +826,7 @@ class ServingState:
             # change a response); serve MoE solo rather than quietly
             log.warn("SERVER_BATCH ignored: MoE capacity is batch-width-"
                      "dependent, dynamic batching could change responses")
-        elif batch > 1:
+        elif batch > 1 and not continuous:
             def fits(selected: list, entry: dict) -> bool:
                 width = _bucket(max(
                     [len(entry["ids"])] + [len(e["ids"]) for e in selected]
@@ -549,6 +879,16 @@ class ServingState:
                     bool(self.kv_quant),
                 ),
                 on_bytes=PREFIX_CACHE_BYTES.set,
+            )
+        if self._continuous:
+            # created LAST: the scheduler thread uses _prefill_any (the
+            # prefix store included), so everything it leans on must be
+            # wired first. K = the early-exit interval — admission and
+            # drain happen between the same-length segments.
+            self._engine = _ContinuousEngine(
+                self, slots=batch if batch > 1 else 4,
+                seg_steps=(self.early_exit_steps
+                           if self.early_exit_steps > 0 else 8),
             )
         self.ready = False
 
@@ -1125,6 +1465,19 @@ class ServingState:
                         ) for t in new
                     ]
             spec = finish.get("spec")
+        elif self._engine is not None and greedy_default:
+            # continuous batching: the scheduler thread owns the decode
+            # loop — this request queues, is admitted into a free slot
+            # between K-step segments (per-row width bucket, warm
+            # prefix included), and its tokens fan back when its row
+            # drains. Queue span = enqueue → slot insert (what
+            # ADMISSION_WAIT measures); per-row output is
+            # token-identical to solo greedy (SlotState independence).
+            entry = self._engine.enqueue(ids, max_new)
+            with TRACER.phase("queue", quiet=True):
+                entry["dispatched"].wait()
+            with TRACER.phase("batch", quiet=True, mode="continuous"):
+                tokens = _Batcher.result(entry)
         elif self._batcher is not None and greedy_default:
             # greedy rows coalesce without changing output, by the
             # ragged-row identity (up to the documented cache-span
@@ -1457,6 +1810,10 @@ class _Handler(BaseHTTPRequestHandler):
             # entries / bytes-vs-cap / signature — the LRU's one-glance
             # mirror (the bytes gauge rides /metrics)
             body["prefix_cache"] = st.prefix_cache.stats()
+        if st._engine is not None:
+            # slot occupancy / queue depth / recycle total — the
+            # engine's one-glance mirror (gauge + counters ride /metrics)
+            body["continuous_batching"] = st._engine.stats()
         if st.prompt_lookup:
             with st._spec_lock:
                 t = dict(st.spec_totals)
