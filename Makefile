@@ -3,7 +3,7 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast obs-check monitor-check perf-check serve-identity-check serve-continuous-check bench dryrun native dist dist-offline clean
+.PHONY: test test-fast obs-check monitor-check perf-check serve-identity-check serve-continuous-check resilience-check bench dryrun native dist dist-offline clean
 
 test:
 	python -m pytest tests/ -q
@@ -66,6 +66,21 @@ serve-continuous-check:
 	  "tests/test_decode.py::test_slot_decode_identity_with_solo_decode" \
 	  "tests/test_perfbench.py::test_continuous_decode_beats_round_based_dispatch" \
 	  -q
+
+# Resilience gate: the serve-path failure-handling suites — deadlines /
+# admission / drain / watchdog units and e2e (test_resilience.py), the
+# deterministic fault-injection harness + chaos matrix (test_faults.py),
+# slot recycling under injected failure, dead-target scrape backoff, and
+# transient terraform retry (docs/guide/serving.md "Resilience").
+resilience-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
+	  tests/test_faults.py tests/test_executor.py \
+	  "tests/test_serve_continuous.py::test_slot_recycled_after_insert_failure" \
+	  "tests/test_serve_continuous.py::test_token_identity_survives_segment_failure" \
+	  "tests/test_fleet_obs.py::test_dead_target_backs_off_with_jitter" \
+	  "tests/test_fleet_obs.py::test_backoff_caps_then_resets_on_success" \
+	  "tests/test_fleet_obs.py::test_backoff_disabled_by_default" \
+	  -q -m "not slow"
 
 bench:
 	python bench.py

@@ -88,3 +88,90 @@ def test_terraform_executor_nonzero_exit(tmp_path):
     ex = TerraformExecutor(terraform_bin=str(stub), stream_output=False)
     with pytest.raises(ExecutorError, match="status 3"):
         ex.apply(make_state())
+
+
+# -- transient-failure retry (bounded, classified, counted) ------------------
+
+
+def test_transient_lock_failure_retries_then_succeeds(tmp_path):
+    """A stub that loses the state lock twice then succeeds: the apply
+    recovers without surfacing an error, and the recovered attempts are
+    visible in tpu_tf_retries_total (which rides run reports)."""
+    from tpu_kubernetes.shell.executor import TF_RETRIES
+
+    stub = tmp_path / "terraform"
+    counter = tmp_path / "n"
+    stub.write_text(
+        "#!/bin/sh\n"
+        f'n=$(cat {counter} 2>/dev/null || echo 0)\n'
+        f'n=$((n+1)); echo $n > {counter}\n'
+        'if [ $n -le 2 ]; then echo "Error acquiring the state lock" >&2; exit 1; fi\n'
+        "exit 0\n"
+    )
+    stub.chmod(0o755)
+    r0 = TF_RETRIES.labels("init").value
+    ex = TerraformExecutor(terraform_bin=str(stub), stream_output=False,
+                           retries=3, retry_backoff_s=0.0)
+    ex.apply(make_state())                     # init fails twice, then ok
+    assert counter.read_text().strip() == "4"  # 3 init attempts + 1 apply
+    assert TF_RETRIES.labels("init").value == r0 + 2
+
+
+def test_retries_exhausted_surfaces_the_error(tmp_path):
+    stub = tmp_path / "terraform"
+    stub.write_text(
+        '#!/bin/sh\necho "Error acquiring the state lock" >&2\nexit 1\n'
+    )
+    stub.chmod(0o755)
+    ex = TerraformExecutor(terraform_bin=str(stub), stream_output=False,
+                           retries=1, retry_backoff_s=0.0)
+    with pytest.raises(ExecutorError, match="state lock"):
+        ex.apply(make_state())
+
+
+def test_non_transient_exit_fails_without_retry(tmp_path):
+    """A real config/plan error (plain nonzero exit) is NOT transient —
+    exactly one attempt runs."""
+    stub = tmp_path / "terraform"
+    log = tmp_path / "calls.log"
+    stub.write_text(f'#!/bin/sh\necho "$@" >> {log}\nexit 3\n')
+    stub.chmod(0o755)
+    ex = TerraformExecutor(terraform_bin=str(stub), stream_output=False,
+                           retries=3, retry_backoff_s=0.0)
+    with pytest.raises(ExecutorError, match="status 3"):
+        ex.apply(make_state())
+    assert log.read_text().splitlines() == ["init -force-copy"]
+
+
+def test_timeout_is_not_retried(tmp_path):
+    import time as _time
+
+    stub = tmp_path / "terraform"
+    stub.write_text("#!/bin/sh\nexec sleep 30\n")
+    stub.chmod(0o755)
+    ex = TerraformExecutor(terraform_bin=str(stub), stream_output=False,
+                           timeout_s=0.3, retries=3, retry_backoff_s=0.0)
+    t0 = _time.monotonic()
+    with pytest.raises(ExecutorError, match="timeout"):
+        ex.apply(make_state())
+    assert _time.monotonic() - t0 < 10         # one attempt, not four
+
+
+def test_injected_terraform_fault_is_retried_then_surfaced(tmp_path):
+    """The fault harness's shell.terraform site classifies as transient
+    (it emulates lock/network blips): prob=1.0 exhausts the budget and
+    surfaces FaultError; with faults cleared the same executor works."""
+    from tpu_kubernetes.obs.faults import FaultError, injected
+    from tpu_kubernetes.shell.executor import TF_RETRIES
+
+    stub = tmp_path / "terraform"
+    stub.write_text("#!/bin/sh\nexit 0\n")
+    stub.chmod(0o755)
+    ex = TerraformExecutor(terraform_bin=str(stub), stream_output=False,
+                           retries=2, retry_backoff_s=0.0)
+    r0 = TF_RETRIES.labels("init").value
+    with injected("shell.terraform:1.0"):
+        with pytest.raises(FaultError):
+            ex.apply(make_state())
+    assert TF_RETRIES.labels("init").value == r0 + 2
+    ex.apply(make_state())                     # healthy again

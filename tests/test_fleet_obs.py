@@ -357,3 +357,77 @@ def test_fleet_rows_version_absent_is_none(two_workers):
     snap = FleetAggregator([a.target, b.target]).scrape_once()
     assert snap.label_value("tpu_k8s_build_info", "version") is None
     assert all(r["version"] is None for r in fleet_rows(snap))
+
+
+# -- dead-target backoff (jittered exponential, reset on success) ------------
+
+
+def test_dead_target_backs_off_with_jitter(two_workers):
+    a, b = two_workers
+    dead = b.target
+    b.stop()
+    agg = FleetAggregator([a.target, dead], timeout_s=1.0, retries=0,
+                          backoff_base_s=10.0)
+
+    snap = agg.scrape_once(now=1000.0)
+    h = snap.health[dead]
+    assert h.up == 0 and h.consecutive_failures == 1
+    assert 8.0 <= h.backoff_s <= 12.0          # base ± 20% jitter
+    assert h.next_scrape_ts == pytest.approx(1000.0 + h.backoff_s)
+
+    # inside the window the dead target is skipped entirely — no timeout
+    # burned, failure count frozen — while the live sibling still scrapes
+    snap = agg.scrape_once(now=1001.0)
+    assert snap.health[dead].consecutive_failures == 1
+    assert snap.value_sum("tpu_serve_tokens_generated_total") == 100
+
+    # past the window it is re-polled and the penalty roughly doubles
+    snap = agg.scrape_once(now=1000.0 + h.backoff_s + 0.01)
+    h2 = snap.health[dead]
+    assert h2.consecutive_failures == 2
+    assert 16.0 <= h2.backoff_s <= 24.0
+
+    # the penalty is a first-class gauge in the merged snapshot
+    backoffs = {s.labels_dict()["instance"]: s.value
+                for s in snap.families["fleet_scrape_backoff_seconds"].samples}
+    assert backoffs[dead] == h2.backoff_s
+    assert backoffs[a.target] == 0.0
+
+
+def test_backoff_caps_then_resets_on_success(two_workers):
+    """Drive a LIVE target dead via the fault harness: the penalty grows
+    to the 8x cap and no further; the first clean scrape zeroes it."""
+    from tpu_kubernetes.obs.faults import injected
+
+    a, _b = two_workers
+    agg = FleetAggregator([a.target], timeout_s=1.0, retries=0,
+                          backoff_base_s=1.0)
+    now = 1000.0
+    with injected("fleet.scrape:1.0"):
+        for _ in range(6):
+            h = agg.scrape_once(now=now).health[a.target]
+            assert h.up == 0
+            assert "injected fault" in h.last_error
+            now = h.next_scrape_ts + 0.01      # jump past each window
+    assert h.consecutive_failures == 6
+    assert h.backoff_s <= 8.0 * 1.2            # capped at 8x base (+jitter)
+    assert h.backoff_s >= 8.0 * 0.8
+
+    # faults cleared → next due scrape succeeds and resets everything
+    h = agg.scrape_once(now=now).health[a.target]
+    assert h.up == 1
+    assert h.consecutive_failures == 0
+    assert h.backoff_s == 0.0 and h.next_scrape_ts == 0.0
+
+
+def test_backoff_disabled_by_default(two_workers):
+    """backoff_base_s=0 (the default, and every one-shot caller) keeps
+    every target in every cycle — no skip window ever opens."""
+    a, b = two_workers
+    dead = b.target
+    b.stop()
+    agg = FleetAggregator([a.target, dead], timeout_s=1.0)
+    agg.scrape_once(now=1000.0)
+    h = agg.scrape_once(now=1000.1).health[dead]
+    assert h.consecutive_failures == 2         # scraped both cycles
+    assert h.backoff_s == 0.0 and h.next_scrape_ts == 0.0

@@ -272,3 +272,51 @@ def test_continuous_rejects_prompt_lookup():
         ServingState(dict(
             ENV, SERVE_CONTINUOUS_BATCHING="1", SERVE_PROMPT_LOOKUP="1",
         ))
+
+
+# ---------------------------------------------------------------------------
+# slot recycling under failure (the resilience layer's fault harness)
+# ---------------------------------------------------------------------------
+
+
+def test_slot_recycled_after_insert_failure(cont_state):
+    """A request whose slot insert blows up is failed out — and the slot
+    it half-claimed is scrubbed and serves the next request cleanly."""
+    from tpu_kubernetes.obs.faults import injected
+
+    eng = cont_state._engine
+    with injected("serve.slot_insert:1.0"):
+        e = eng.enqueue(cont_state.encode(PROMPTS[1]), 4)
+        assert e["event"].wait(60)
+        with pytest.raises(Exception, match="injected fault"):
+            _Batcher.result(e)
+    _settle(lambda: eng.stats()["occupied"] == 0)
+    # with faults cleared the same slots serve clean traffic immediately
+    outs = _fan_out(cont_state, PROMPTS[:2], [4, 4])
+    assert all(o["text"] for o in outs)
+    _settle(lambda: SLOT_OCCUPANCY.value == 0)
+
+
+def test_token_identity_survives_segment_failure(solo_state, cont_state):
+    """A mid-decode segment failure errors the resident rows out (they
+    reach a terminal state, not a hang) and resets the engine cold —
+    after which a full mixed batch must still be token-identical with
+    solo decode. Failure recovery must never corrupt decode state."""
+    from tpu_kubernetes.obs.faults import injected
+
+    eng = cont_state._engine
+    with injected("serve.segment:1.0"):
+        e = eng.enqueue(cont_state.encode(PROMPTS[0]), 8)
+        assert e["event"].wait(60)
+        with pytest.raises(Exception, match="injected fault"):
+            _Batcher.result(e)
+    _settle(lambda: eng.stats()["occupied"] == 0
+            and eng.stats()["queued"] == 0)
+    refs = [
+        solo_state.complete(p, max_new_tokens=b)
+        for p, b in zip(PROMPTS, BUDGETS)
+    ]
+    outs = _fan_out(cont_state, PROMPTS, BUDGETS)
+    for out, ref in zip(outs, refs):
+        assert out["text"] == ref["text"]
+        assert out["tokens"] == ref["tokens"]

@@ -19,6 +19,7 @@ failure counts survive across cycles, so one dead worker reads as
 from __future__ import annotations
 
 import math
+import random
 import threading
 import time
 import urllib.request
@@ -27,11 +28,17 @@ from dataclasses import dataclass, field, replace
 from typing import Callable
 
 from tpu_kubernetes.obs import expfmt
+from tpu_kubernetes.obs.faults import FAULTS
 
 # synthetic per-target families the aggregator itself contributes
 UP = "up"
 SCRAPE_SECONDS = "fleet_scrape_duration_seconds"
 SCRAPE_FAILURES = "fleet_scrape_consecutive_failures"
+SCRAPE_BACKOFF = "fleet_scrape_backoff_seconds"
+
+# exponential backoff cap, as a multiple of the base interval: a target
+# that stays dead is re-polled at ~8x the normal period, not never
+BACKOFF_CAP_MULT = 8.0
 
 
 @dataclass
@@ -42,6 +49,8 @@ class TargetHealth:
     last_scrape_seconds: float = 0.0
     last_error: str = ""
     last_success_ts: float = 0.0
+    backoff_s: float = 0.0       # current penalty (0 = none / disabled)
+    next_scrape_ts: float = 0.0  # skip scrapes until this timestamp
 
 
 @dataclass
@@ -156,12 +165,19 @@ class FleetAggregator:
     health state is cumulative across cycles under one lock."""
 
     def __init__(self, targets: list[str], timeout_s: float = 2.0,
-                 retries: int = 1, max_workers: int = 16):
+                 retries: int = 1, max_workers: int = 16,
+                 backoff_base_s: float = 0.0):
         self._targets = [_normalize_target(t) for t in targets]
         if not self._targets:
             raise ValueError("FleetAggregator needs at least one target")
         self.timeout_s = timeout_s
         self.retries = max(0, int(retries))
+        # backoff_base_s > 0 (callers pass their poll interval) arms
+        # jittered exponential backoff for dead targets: consecutive
+        # failures double the re-poll delay up to BACKOFF_CAP_MULT x the
+        # base, reset on success. 0 keeps every cycle scraping every
+        # target (one-shot callers want the immediate answer).
+        self.backoff_base_s = max(0.0, float(backoff_base_s))
         self._max_workers = max(1, min(max_workers, len(self._targets)))
         self._lock = threading.Lock()
         self._health: dict[str, TargetHealth] = {
@@ -181,6 +197,7 @@ class FleetAggregator:
         t0 = time.monotonic()
         for _ in range(self.retries + 1):
             try:
+                FAULTS.fire("fleet.scrape")
                 families = expfmt.parse(self._fetch(url))
             except Exception as e:  # noqa: BLE001 — per-target isolation
                 last_error = f"{type(e).__name__}: {e}"[:200]
@@ -204,10 +221,23 @@ class FleetAggregator:
         fails the cycle — it contributes ``up=0`` and keeps its last
         error on record."""
         now = time.time() if now is None else now
-        with ThreadPoolExecutor(max_workers=self._max_workers) as pool:
-            results = list(pool.map(
-                lambda t: self._scrape_target(*t), self._targets
-            ))
+        # dead targets still inside their backoff window are skipped this
+        # cycle (they keep their up=0 / failure-count reading); everyone
+        # else scrapes concurrently
+        with self._lock:
+            due = [
+                (instance, url) for instance, url in self._targets
+                if not (self.backoff_base_s > 0
+                        and self._health[instance].next_scrape_ts > now)
+            ]
+        results: list[ScrapeResult] = []
+        if due:
+            with ThreadPoolExecutor(
+                max_workers=min(self._max_workers, len(due))
+            ) as pool:
+                results = list(pool.map(
+                    lambda t: self._scrape_target(*t), due
+                ))
 
         with self._lock:
             for r in results:
@@ -218,9 +248,23 @@ class FleetAggregator:
                     h.consecutive_failures = 0
                     h.last_error = ""
                     h.last_success_ts = now
+                    h.backoff_s = 0.0
+                    h.next_scrape_ts = 0.0
                 else:
                     h.consecutive_failures += 1
                     h.last_error = r.error
+                    if self.backoff_base_s > 0:
+                        raw = min(
+                            self.backoff_base_s
+                            * 2.0 ** (h.consecutive_failures - 1),
+                            BACKOFF_CAP_MULT * self.backoff_base_s,
+                        )
+                        # ±20% jitter so a fleet of aggregators doesn't
+                        # re-poll a recovering target in lockstep
+                        h.backoff_s = round(
+                            raw * random.uniform(0.8, 1.2), 6
+                        )
+                        h.next_scrape_ts = now + h.backoff_s
             health = {i: replace(h) for i, h in self._health.items()}
 
         merged: dict[str, expfmt.Family] = {}
@@ -242,6 +286,9 @@ class FleetAggregator:
              "gauge", lambda h: h.last_scrape_seconds),
             (SCRAPE_FAILURES, "scrape failures since the last success",
              "gauge", lambda h: float(h.consecutive_failures)),
+            (SCRAPE_BACKOFF, "current re-poll backoff for the target "
+             "(0 = healthy or backoff disabled)",
+             "gauge", lambda h: h.backoff_s),
         ):
             merged[name] = expfmt.Family(
                 name=name, help=help_, kind=kind,

@@ -146,7 +146,13 @@ def run_monitor(targets: list[str], interval: float = 5.0,
                 timeout_s: float = 2.0) -> int:
     """The CLI loop. Returns the process exit code."""
     out = sys.stdout if out is None else out
-    aggregator = FleetAggregator(targets, timeout_s=timeout_s)
+    # the poll interval doubles as the backoff base: a dead target falls
+    # back to ~8x interval re-polls instead of burning a timeout per
+    # cycle forever (one-shot runs keep every target in the cycle)
+    aggregator = FleetAggregator(
+        targets, timeout_s=timeout_s,
+        backoff_base_s=0.0 if once else interval,
+    )
     trackers = default_slos() if slos is None else slos
     prev: FleetSnapshot | None = None
     cycles = 0

@@ -117,7 +117,23 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tpu_kubernetes.obs import REGISTRY, events
 from tpu_kubernetes.obs import metrics as obs_metrics
+from tpu_kubernetes.obs.faults import FAULTS
 from tpu_kubernetes.obs.profile import PhaseProfiler
+from tpu_kubernetes.serve.resilience import (
+    CANCELLED_TOTAL,
+    DEADLINE_TOTAL,
+    ENGINE_RESTARTS,
+    AdmissionController,
+    Cancelled,
+    DeadlineExceeded,
+    DrainController,
+    Draining,
+    Overloaded,
+    Watchdog,
+    deadline_from,
+    expired,
+    warn_once,
+)
 from tpu_kubernetes.util import log
 from tpu_kubernetes.util.trace import TRACER, span_tree
 
@@ -293,7 +309,7 @@ class _Batcher:
     check finds no row live), so co-riding never changes a response."""
 
     def __init__(self, run_batch, max_batch: int, window_ms: float,
-                 fits=None):
+                 fits=None, on_wait=None):
         self._run_batch = run_batch        # (entries) → None, sets results
         self.max_batch = max_batch
         self.window_s = window_ms / 1e3
@@ -301,23 +317,34 @@ class _Batcher:
         # combined width/max_new span must stay within max_seq — two
         # individually-valid requests can be jointly invalid)
         self._fits = fits or (lambda selected, entry: True)
+        # on_wait(seconds): admission control learns its queue-wait
+        # estimate from the same waits QUEUE_SECONDS records
+        self._on_wait = on_wait
         self._queue: list[dict] = []
         self._cond = threading.Condition()
         self._thread = threading.Thread(target=self._dispatch, daemon=True)
         self._thread.start()
 
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
     def enqueue(self, ids: list, max_new: int,
-                budget: int | None = None) -> dict:
+                budget: int | None = None,
+                deadline: float | None = None) -> dict:
         """Queue a request; returns the entry. ``entry["dispatched"]``
         fires when the dispatcher selects it into a batch (the end of its
         queue wait) and ``entry["event"]`` when its result is ready —
         split so the caller can time the two stages as separate trace
         spans. ``budget`` is the REQUESTED max_new (≤ the bucketed
         ``max_new`` the program runs) — the early-exit decode loop stops
-        counting a row live once its own budget is emitted."""
+        counting a row live once its own budget is emitted. ``deadline``
+        (monotonic) makes the dispatcher fail the entry out instead of
+        selecting it once expired."""
         entry = {
             "ids": ids, "max_new": max_new, "t_enq": time.monotonic(),
             "budget": max_new if budget is None else budget,
+            "deadline": deadline,
             "event": threading.Event(), "dispatched": threading.Event(),
             "tokens": None, "error": None,
         }
@@ -355,6 +382,26 @@ class _Batcher:
                         break
                     self._cond.wait(timeout=left)
                 pending, self._queue = self._queue, []
+            # deadline reaping happens HERE, the one point every entry
+            # passes before costing device time: an expired entry is
+            # failed out (504), never selected — one slow round cannot
+            # cascade a queue full of already-dead requests into more
+            # dead rounds
+            now = time.monotonic()
+            live: list[dict] = []
+            for entry in pending:
+                if expired(entry.get("deadline"), now):
+                    DEADLINE_TOTAL.labels("queued").inc()
+                    entry["error"] = DeadlineExceeded(
+                        "deadline expired while queued for dispatch"
+                    )
+                    entry["dispatched"].set()
+                    entry["event"].set()
+                else:
+                    live.append(entry)
+            pending = live
+            if not pending:
+                continue
             batch: list[dict] = []
             rest: list[dict] = []
             err: Exception | None = None
@@ -383,6 +430,8 @@ class _Batcher:
                 now = time.monotonic()
                 for entry in batch:
                     QUEUE_SECONDS.observe(now - entry["t_enq"])
+                    if self._on_wait is not None:
+                        self._on_wait(now - entry["t_enq"])
                     entry["dispatched"].set()
                 BATCH_SIZE.observe(len(batch))
                 try:
@@ -454,21 +503,27 @@ class _ContinuousEngine:
         self._pl = np.zeros(slots, np.int32)
         self._ps = np.zeros(slots, np.int32)
         self.recycled = 0
+        self.restarts = 0
         self._cache = init_cache(
             state.cfg, slots, self.span, kv_quant=state.kv_quant
         )
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
-    def enqueue(self, ids: list, max_new: int) -> dict:
+    def enqueue(self, ids: list, max_new: int,
+                deadline: float | None = None,
+                cancel: threading.Event | None = None) -> dict:
         """Queue a request; same entry contract as _Batcher.enqueue
         (``dispatched`` fires at slot insert — the end of the admission
         wait — ``event`` when the row's tokens are ready), so
         complete() consumes engine and batcher entries through one
-        code path (_Batcher.result)."""
+        code path (_Batcher.result). ``deadline`` (monotonic) and
+        ``cancel`` make the scheduler fail the entry out of the queue —
+        or retire it MID-FLIGHT from its slot — once it stops being
+        worth serving."""
         entry = {
             "ids": ids, "max_new": max_new, "t_enq": time.monotonic(),
-            "budget": max_new,
+            "budget": max_new, "deadline": deadline, "cancel": cancel,
             "event": threading.Event(), "dispatched": threading.Event(),
             "tokens": None, "error": None,
         }
@@ -476,6 +531,10 @@ class _ContinuousEngine:
             self._queue.append(entry)
             self._cond.notify()
         return entry
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
 
     def stats(self) -> dict:
         """One-glance engine state for /healthz (the gauges/counters
@@ -488,6 +547,7 @@ class _ContinuousEngine:
             "queued": queued,
             "segment_steps": self.seg_steps,
             "recycled": self.recycled,
+            "restarts": self.restarts,
         }
 
     # -- scheduler thread ---------------------------------------------------
@@ -503,14 +563,48 @@ class _ContinuousEngine:
                 ):
                     self._cond.wait()
             try:
+                self._reap()
                 self._admit()
                 self._run_segment()
             except Exception as e:  # noqa: BLE001 — surfaced per entry
                 self._fail_out(e)
 
+    def _reap(self) -> None:
+        """Retire expired/cancelled RESIDENT rows mid-flight: the entry
+        fails out (504 / cancelled), its slot is cache_clear_row-reset
+        and its budget returns to admission — one slow client's
+        deadline never holds a slot against queued traffic. Runs before
+        _admit so a freed slot admits in the same scheduler pass."""
+        reaped = False
+        now = time.monotonic()
+        for i, entry in enumerate(self._entries):
+            if entry is None:
+                continue
+            cancel = entry.get("cancel")
+            if cancel is not None and cancel.is_set():
+                CANCELLED_TOTAL.labels("engine").inc()
+                entry["error"] = Cancelled(
+                    "request cancelled — slot retired mid-flight"
+                )
+            elif expired(entry.get("deadline"), now):
+                DEADLINE_TOTAL.labels("resident").inc()
+                entry["error"] = DeadlineExceeded(
+                    "deadline expired mid-decode — slot retired"
+                )
+            else:
+                continue
+            entry["dispatched"].set()
+            entry["event"].set()
+            self._retire(i)
+            reaped = True
+        if reaped:
+            SLOT_OCCUPANCY.set(sum(e is not None for e in self._entries))
+
     def _admit(self) -> None:
         """Fill free slots from the queue (FIFO). Per-entry failures
-        (a bad prefill) fail that entry out; the engine keeps serving."""
+        (a bad prefill) fail that entry out; the engine keeps serving.
+        Entries whose deadline expired while queued are failed out
+        WITHOUT spending a prefill on them."""
         while True:
             free = next(
                 (i for i, e in enumerate(self._entries) if e is None),
@@ -522,18 +616,30 @@ class _ContinuousEngine:
                 if not self._queue:
                     return
                 entry = self._queue.pop(0)
+            if expired(entry.get("deadline")):
+                DEADLINE_TOTAL.labels("queued").inc()
+                entry["error"] = DeadlineExceeded(
+                    "deadline expired while queued for a slot"
+                )
+                entry["dispatched"].set()
+                entry["event"].set()
+                continue
             try:
                 self._insert(entry, free)
             except Exception as e:  # noqa: BLE001 — this entry only
                 entry["error"] = e
                 entry["dispatched"].set()
                 entry["event"].set()
+                # the graft may have half-landed: scrub the row so the
+                # slot the next admission reuses is bitwise cold
+                self._clear_row(free, best_effort=True)
 
     def _insert(self, entry: dict, slot: int) -> None:
         import numpy as np
 
         from tpu_kubernetes.models.decode import cache_insert_row
 
+        FAULTS.fire("serve.slot_insert")
         st = self._state
         jax = st._jax
         ids, budget = entry["ids"], entry["budget"]
@@ -557,10 +663,12 @@ class _ContinuousEngine:
                     ),
                 )
                 self._cache = ins(self._cache, row, slot)
+        wait = time.monotonic() - entry["t_enq"]
+        ADMISSION_WAIT.observe(wait)
+        st.admission.observe_service(wait)
         if entry["tokens"] is not None:
             entry["dispatched"].set()
             entry["event"].set()
-            ADMISSION_WAIT.observe(time.monotonic() - entry["t_enq"])
             return
         self._entries[slot] = entry
         self._collected[slot] = [first]
@@ -570,7 +678,6 @@ class _ContinuousEngine:
         self._pl[slot] = len(ids)
         self._ps[slot] = width
         entry["dispatched"].set()
-        ADMISSION_WAIT.observe(time.monotonic() - entry["t_enq"])
         SLOT_OCCUPANCY.set(sum(e is not None for e in self._entries))
 
     def _run_segment(self) -> None:
@@ -591,6 +698,7 @@ class _ContinuousEngine:
         jax = st._jax
         if all(e is None for e in self._entries):
             return
+        FAULTS.fire("serve.segment")
         steps = self.seg_steps
         seg = st._cached_program(
             ("slot_segment", steps),
@@ -630,17 +738,28 @@ class _ContinuousEngine:
                 self._retire(i)
         SLOT_OCCUPANCY.set(sum(e is not None for e in self._entries))
 
-    def _retire(self, slot: int) -> None:
+    def _clear_row(self, slot: int, best_effort: bool = False) -> None:
+        """cache_clear_row slot ``slot`` back to bitwise-cold. With
+        ``best_effort`` (the failed-insert scrub) a clear failure is
+        swallowed: the slot row is numerically inert for attention
+        either way, and the scrub must not mask the original error."""
         from tpu_kubernetes.models.decode import cache_clear_row
 
         st = self._state
         jax = st._jax
-        clr = st._cached_program(
-            ("slot_clear",),
-            lambda: jax.jit(cache_clear_row, donate_argnums=(0,)),
-        )
-        with st._lock:
-            self._cache = clr(self._cache, slot)
+        try:
+            clr = st._cached_program(
+                ("slot_clear",),
+                lambda: jax.jit(cache_clear_row, donate_argnums=(0,)),
+            )
+            with st._lock:
+                self._cache = clr(self._cache, slot)
+        except Exception:  # noqa: BLE001 — scrub only
+            if not best_effort:
+                raise
+
+    def _retire(self, slot: int) -> None:
+        self._clear_row(slot)
         self._entries[slot] = None
         self._collected[slot] = []
         self._pos[slot] = self._tok[slot] = self._rem[slot] = 0
@@ -673,6 +792,22 @@ class _ContinuousEngine:
             e["dispatched"].set()
             e["event"].set()
         SLOT_OCCUPANCY.set(0)
+
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def restart(self) -> None:
+        """Watchdog recovery: fail all in-flight work out, reset the
+        engine cold, start a fresh scheduler thread. The _loop contract
+        means this only runs when the thread died anyway (an escape the
+        per-pass try should have caught), so correctness over grace."""
+        self._fail_out(RuntimeError(
+            "continuous engine scheduler died — restarted cold"
+        ))
+        self.restarts += 1
+        ENGINE_RESTARTS.inc()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
 
 
 class ServingState:
@@ -711,6 +846,23 @@ class ServingState:
         )
         self._lock = threading.Lock()
         self._jax = jax
+        # -- resilience policy (serve/resilience.py) --------------------
+        # SERVE_DEADLINE_MS (default 0 = off): every request gets this
+        # deadline unless its body carries a "deadline_ms" override; the
+        # clock starts at request receipt (queue time counts).
+        self.deadline_ms = float(env.get("SERVE_DEADLINE_MS", "0") or 0)
+        # SERVE_MAX_QUEUE (default 256, 0 disables): admission control —
+        # a full queue sheds with 429 + Retry-After instead of queueing
+        # unboundedly behind the generation lock.
+        self.admission = AdmissionController(
+            int(env.get("SERVE_MAX_QUEUE", "256") or 0)
+        )
+        self.drain = DrainController()
+        self.failed = False          # watchdog gave up: healthz hard-fails
+        self._busy = 0               # requests inside complete()/stream()
+        self._busy_lock = threading.Lock()
+        self._http_server = None     # set by make_server (drain shutdown)
+        self._watchdog = None
 
         # SERVE_MESH (e.g. "tensor=4"): serve the fused path TENSOR-
         # SHARDED over this host's chips (parallel/serving.py) — models
@@ -787,10 +939,11 @@ class ServingState:
             )
         if continuous and (self.mesh is not None
                            or isinstance(cfg, MoEConfig)):
-            log.warn(
+            warn_once(
+                "continuous_mesh_moe",
                 "SERVE_CONTINUOUS_BATCHING ignored: the slot engine "
                 "needs a single-device dense model (sharded serving is "
-                "fused; MoE capacity is batch-width-dependent)"
+                "fused; MoE capacity is batch-width-dependent)",
             )
             continuous = False
         self._continuous = continuous
@@ -824,8 +977,11 @@ class ServingState:
             # the ragged-row identity batching leans on is weaker for MoE
             # (capacity is computed at the padded width — co-riders could
             # change a response); serve MoE solo rather than quietly
-            log.warn("SERVER_BATCH ignored: MoE capacity is batch-width-"
-                     "dependent, dynamic batching could change responses")
+            warn_once(
+                "batch_moe",
+                "SERVER_BATCH ignored: MoE capacity is batch-width-"
+                "dependent, dynamic batching could change responses",
+            )
         elif batch > 1 and not continuous:
             def fits(selected: list, entry: dict) -> bool:
                 width = _bucket(max(
@@ -842,6 +998,7 @@ class ServingState:
                 self._run_greedy_batch, batch,
                 float(env.get("SERVER_BATCH_WINDOW_MS", "5")),
                 fits=fits,
+                on_wait=self.admission.observe_service,
             )
 
         # SERVE_EARLY_EXIT_STEPS: the host-side liveness check interval
@@ -863,10 +1020,11 @@ class ServingState:
         prefix_mb = float(env.get("SERVE_PREFIX_CACHE_MB", "0") or "0")
         if prefix_mb > 0 and (self.mesh is not None
                               or isinstance(cfg, MoEConfig)):
-            log.warn(
+            warn_once(
+                "prefix_cache_mesh_moe",
                 "SERVE_PREFIX_CACHE_MB ignored: prefix reuse needs a "
                 "single-device dense model (sharded serving is fused; "
-                "MoE capacity is chunk-length-dependent)"
+                "MoE capacity is chunk-length-dependent)",
             )
         elif prefix_mb > 0:
             from tpu_kubernetes.serve.prefix_cache import PrefixCache
@@ -890,7 +1048,26 @@ class ServingState:
                 seg_steps=(self.early_exit_steps
                            if self.early_exit_steps > 0 else 8),
             )
+            # self-healing: a dead scheduler thread would hang every
+            # future submitter — restart it cold, bounded times
+            # (SERVE_MAX_ENGINE_RESTARTS), then hard-fail /healthz so
+            # the fleet replaces this instance.
+            self._watchdog = Watchdog(
+                self._engine.is_alive, self._engine.restart,
+                max_restarts=int(
+                    env.get("SERVE_MAX_ENGINE_RESTARTS", "3") or 0
+                ),
+                interval_s=float(
+                    env.get("SERVE_WATCHDOG_INTERVAL_S", "0.5") or 0.5
+                ),
+                on_give_up=self._mark_failed,
+            ).start()
         self.ready = False
+
+    def _mark_failed(self) -> None:
+        self.failed = True
+        events.emit("serve_engine_failed",
+                    restarts=self._engine.restarts if self._engine else 0)
 
     def warm(self) -> None:
         """Compile the programs DEFAULT requests use — the segmented
@@ -905,6 +1082,98 @@ class ServingState:
                 pass
         self.ready = True
         log.info("server: warm — default programs compiled, serving")
+
+    # -- resilience -----------------------------------------------------
+
+    @contextlib.contextmanager
+    def _track_busy(self):
+        """Count a request through generation — the drain worker waits
+        for this to reach zero before declaring the instance quiesced."""
+        with self._busy_lock:
+            self._busy += 1
+        try:
+            yield
+        finally:
+            with self._busy_lock:
+                self._busy -= 1
+
+    def _preflight(self, deadline: float | None) -> None:
+        """Admission gate, run before any generation work: draining
+        instances refuse (503), already-expired deadlines fail fast
+        (504 without spending a prefill), and the admission controller
+        sheds (429) when the queue is full or the estimated wait has
+        already doomed the deadline. Warm-up traffic (pre-ready) is
+        exempt — policy applies to live traffic only."""
+        if not self.ready:
+            return
+        if self.failed:
+            # the watchdog gave up on this instance: an enqueue would
+            # hang on a dead scheduler forever — fail loudly instead
+            raise RuntimeError(
+                "serving engine failed permanently (watchdog restarts "
+                "exhausted) — this instance must be replaced"
+            )
+        if self.drain.is_draining:
+            raise Draining(
+                "server is draining — retry against a sibling instance"
+            )
+        if expired(deadline):
+            DEADLINE_TOTAL.labels("preflight").inc()
+            raise DeadlineExceeded(
+                "deadline expired before generation started"
+            )
+        if self._engine is not None:
+            depth = self._engine.depth()
+        elif self._batcher is not None:
+            depth = self._batcher.depth()
+        else:
+            with self._busy_lock:
+                depth = self._busy
+        self.admission.admit(depth, deadline)
+
+    def _quiesced(self) -> bool:
+        with self._busy_lock:
+            if self._busy:
+                return False
+        if self._engine is not None:
+            s = self._engine.stats()
+            if s["occupied"] or s["queued"]:
+                return False
+        if self._batcher is not None and self._batcher.depth():
+            return False
+        return True
+
+    def begin_drain(self, reason: str = "") -> bool:
+        """Stop admission NOW (new requests 503), then finish resident
+        work in the background and shut the HTTP server down once
+        quiesced (bounded by SERVE_DRAIN_TIMEOUT_S). Idempotent — the
+        first caller starts the worker, later calls report False."""
+        if not self.drain.begin(reason):
+            return False
+        threading.Thread(
+            target=self._drain_worker, daemon=True, name="serve-drain"
+        ).start()
+        return True
+
+    def _drain_worker(self) -> None:
+        timeout = float(self.env.get("SERVE_DRAIN_TIMEOUT_S", "30") or 30)
+        t_end = time.monotonic() + timeout
+        while time.monotonic() < t_end and not self._quiesced():
+            time.sleep(0.05)
+        forced = not self._quiesced()
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        # the terminal event IS the flush (the sink writes through), and
+        # /metrics stays scrapeable until the listener closes below
+        events.emit("serve_drained",
+                    reason=self.drain.reason, forced=forced)
+        self.drain.mark_drained()
+        log.info("server: drained"
+                 + (" (timeout — residual work abandoned)" if forced
+                    else ""))
+        srv = self._http_server
+        if srv is not None:
+            srv.shutdown()
 
     @contextlib.contextmanager
     def _locked_phase(self):
@@ -1038,14 +1307,25 @@ class ServingState:
         n = len(ids)
         if self.prefix_cache is None or n < MIN_PREFIX_TOKENS:
             return
-        arrays = {
-            "k": cache.k[:, row:row + 1, :, :n],
-            "v": cache.v[:, row:row + 1, :, :n],
-        }
-        if cache.k_scale is not None:
-            arrays["k_scale"] = cache.k_scale[:, row:row + 1, :, :n]
-            arrays["v_scale"] = cache.v_scale[:, row:row + 1, :, :n]
-        self.prefix_cache.insert(ids, arrays)
+        # best-effort: the store is an accelerator, not a correctness
+        # dependency — a failed insert must not fail the request that
+        # already has its tokens
+        try:
+            FAULTS.fire("serve.prefix_insert")
+            arrays = {
+                "k": cache.k[:, row:row + 1, :, :n],
+                "v": cache.v[:, row:row + 1, :, :n],
+            }
+            if cache.k_scale is not None:
+                arrays["k_scale"] = cache.k_scale[:, row:row + 1, :, :n]
+                arrays["v_scale"] = cache.v_scale[:, row:row + 1, :, :n]
+            self.prefix_cache.insert(ids, arrays)
+        except Exception as e:  # noqa: BLE001 — accelerator only
+            warn_once(
+                "prefix_insert_failed",
+                f"prefix-cache insert failed (serving continues without "
+                f"storing): {type(e).__name__}: {e}",
+            )
 
     def _expand_prefix(self, arrays: dict, q: int, span: int, b: int):
         """A stored segment → the resume base cache: ``q`` real slots,
@@ -1138,6 +1418,7 @@ class ServingState:
         insert, and the cache metrics all live here so every solo call
         site (complete / stream / single-entry batch rounds) shares one
         policy."""
+        FAULTS.fire("serve.prefill")
         q, entry = (0, None)
         if self.prefix_cache is not None:
             q, entry = self._prefix_lookup(ids)
@@ -1442,11 +1723,13 @@ class ServingState:
 
     def complete(self, prompt: str, max_new_tokens: int | None = None,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 0.0, seed: int = 0) -> dict:
+                 top_p: float = 0.0, seed: int = 0,
+                 deadline: float | None = None) -> dict:
         jax = self._jax
         import jax.numpy as jnp
         import numpy as np
 
+        self._preflight(deadline)
         ids, max_new, run_max_new, width = self._validate(
             prompt, max_new_tokens
         )
@@ -1473,7 +1756,7 @@ class ServingState:
             # drains. Queue span = enqueue → slot insert (what
             # ADMISSION_WAIT measures); per-row output is
             # token-identical to solo greedy (SlotState independence).
-            entry = self._engine.enqueue(ids, max_new)
+            entry = self._engine.enqueue(ids, max_new, deadline=deadline)
             with TRACER.phase("queue", quiet=True):
                 entry["dispatched"].wait()
             with TRACER.phase("batch", quiet=True, mode="continuous"):
@@ -1486,7 +1769,8 @@ class ServingState:
             # the batch span when its rows come back — the same boundary
             # QUEUE_SECONDS measures.
             entry = self._batcher.enqueue(ids, run_max_new,
-                                          budget=max_new)
+                                          budget=max_new,
+                                          deadline=deadline)
             with TRACER.phase("queue", quiet=True):
                 entry["dispatched"].wait()
             with TRACER.phase("batch", quiet=True, mode="batched"):
@@ -1553,7 +1837,9 @@ class ServingState:
     def stream(self, prompt: str, max_new_tokens: int | None = None,
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 0.0, seed: int = 0,
-               finish: dict | None = None):
+               finish: dict | None = None,
+               deadline: float | None = None,
+               cancel: threading.Event | None = None):
         """Yield text pieces as tokens decode: prefill once, then a
         per-token jitted decode_step+sample loop (the fused generate
         cannot surface tokens before the scan finishes). Each piece is
@@ -1571,6 +1857,7 @@ class ServingState:
                 "streaming is not available under SERVE_MESH (sharded "
                 "serving uses the fused program) — drop \"stream\""
             )
+        self._preflight(deadline)
         ids, max_new, run_max_new, width = self._validate(
             prompt, max_new_tokens
         )
@@ -1636,6 +1923,16 @@ class ServingState:
             tail_n = 0
             try:
                 for i in range(max_new):
+                    if cancel is not None and cancel.is_set():
+                        # the client stopped listening: stop paying for
+                        # tokens nobody reads (the lock releases on
+                        # return); the handler already counted the cause
+                        return
+                    if expired(deadline):
+                        DEADLINE_TOTAL.labels("resident").inc()
+                        raise DeadlineExceeded(
+                            "deadline expired mid-stream"
+                        )
                     t = int(np.asarray(tok)[0])
                     if self.eos_id is not None and t == self.eos_id:
                         if finish is not None:
@@ -1681,7 +1978,7 @@ class _Handler(BaseHTTPRequestHandler):
     # path-scanning client can't mint unbounded label cardinality
     _ENDPOINTS = frozenset({
         "/healthz", "/metrics", "/v1/models", "/debug/profile",
-        "/v1/completions", "/v1/chat/completions",
+        "/v1/completions", "/v1/chat/completions", "/drain",
     })
 
     def log_message(self, fmt, *args):
@@ -1689,12 +1986,15 @@ class _Handler(BaseHTTPRequestHandler):
         # -q/--verbose apply to the serving path like everywhere else
         log.debug(f"server: {self.address_string()} {fmt % args}")
 
-    def _json(self, code: int, obj: dict) -> None:
+    def _json(self, code: int, obj: dict,
+              headers: dict | None = None) -> None:
         self._code = code
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -1792,8 +2092,26 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(200, {"run": rid, "spans": tree})
         if self.path != "/healthz":
             return self._json(404, {"error": "unknown path"})
+        if st.failed:
+            # the watchdog gave up: this instance cannot serve — a hard
+            # 503 (distinct from "warming"/"draining") tells the fleet
+            # to replace it, not wait for it
+            return self._json(503, {
+                "status": "failed",
+                "reason": "engine watchdog exhausted its restarts",
+                "restarts": (st._engine.restarts
+                             if st._engine is not None else 0),
+            })
         if not st.ready:
             return self._json(503, {"status": "warming"})
+        if st.drain.is_draining:
+            # 503 flips the readiness probe so the load balancer stops
+            # routing here while resident work finishes
+            return self._json(503, {
+                "status": st.drain.state,
+                "reason": st.drain.reason,
+                "busy": st._busy,
+            })
         body = {
             "status": "ok",
             "model": st.model_name,
@@ -1814,6 +2132,13 @@ class _Handler(BaseHTTPRequestHandler):
             # slot occupancy / queue depth / recycle total — the
             # engine's one-glance mirror (gauge + counters ride /metrics)
             body["continuous_batching"] = st._engine.stats()
+        # the resilience policy at a glance (shed/deadline/cancel/restart
+        # counters ride /metrics)
+        body["resilience"] = {
+            "state": st.drain.state,
+            "deadline_ms_default": st.deadline_ms,
+            "max_queue": st.admission.max_queue,
+        }
         if st.prompt_lookup:
             with st._spec_lock:
                 t = dict(st.spec_totals)
@@ -1847,54 +2172,104 @@ class _Handler(BaseHTTPRequestHandler):
         return "\n".join(parts)
 
     def _post(self):
+        st = self.state
+        if self.path == "/drain":
+            # the Kubernetes preStop contract: stop admission now,
+            # finish resident work, shut the listener down — 202 because
+            # the drain completes after this response
+            accepted = st.begin_drain("POST /drain")
+            return self._json(202, {
+                "status": st.drain.state,
+                "accepted": accepted,
+            })
         chat = self.path == "/v1/chat/completions"
         if self.path != "/v1/completions" and not chat:
             return self._json(404, {"error": "unknown path"})
-        if not self.state.ready:
+        if not st.ready:
             return self._json(503, {"error": "warming"})
+        if st.failed:
+            return self._json(503, {
+                "error": "serving engine failed — instance is being "
+                         "replaced"})
+        stream_ctx = None
         try:
-            length = int(self.headers.get("Content-Length", "0"))
-            body = json.loads(self.rfile.read(length) or b"{}")
-            if not isinstance(body, dict):
-                raise ValueError("body must be a JSON object")
-            if chat:
-                prompt = self._chat_prompt(body.get("messages"))
-                # OpenAI chat spells the budget "max_tokens"
-                max_new = body.get(
-                    "max_tokens", body.get("max_new_tokens")
-                )
-            else:
-                if "prompt" not in body:
-                    raise ValueError(
-                        'body must be a JSON object with "prompt"'
+            with st._track_busy():
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+                if chat:
+                    prompt = self._chat_prompt(body.get("messages"))
+                    # OpenAI chat spells the budget "max_tokens"
+                    max_new = body.get(
+                        "max_tokens", body.get("max_new_tokens")
                     )
-                prompt = str(body["prompt"])
-                # OpenAI's legacy completions API spells it "max_tokens"
-                max_new = body.get(
-                    "max_new_tokens", body.get("max_tokens")
+                else:
+                    if "prompt" not in body:
+                        raise ValueError(
+                            'body must be a JSON object with "prompt"'
+                        )
+                    prompt = str(body["prompt"])
+                    # OpenAI's legacy completions API spells it "max_tokens"
+                    max_new = body.get(
+                        "max_new_tokens", body.get("max_tokens")
+                    )
+                dl_ms = body.get("deadline_ms")
+                if dl_ms is not None:
+                    dl_ms = float(dl_ms)
+                    if dl_ms <= 0:
+                        raise ValueError('"deadline_ms" must be > 0')
+                # anchored at request RECEIPT (self._t0) — time already
+                # spent reading/parsing counts against the deadline
+                deadline = deadline_from(
+                    self._t0, dl_ms, default_ms=st.deadline_ms
                 )
-            kwargs = dict(
-                max_new_tokens=max_new,
-                temperature=body.get("temperature", 0.0),
-                top_k=body.get("top_k", 0),
-                top_p=body.get("top_p", 0.0),
-                seed=body.get("seed", 0),
-            )
-            if body.get("stream"):
-                # validate (and pay the first device call) BEFORE the
-                # 200 status goes out — errors must still be a 400
-                finish: dict = {}
-                pieces = self.state.stream(prompt, finish=finish, **kwargs)
-                first = next(pieces, None)
-                TTFT_SECONDS.observe(time.monotonic() - self._t0)
-                return self._stream_sse(
-                    first, pieces, chat=chat, finish=finish
+                kwargs = dict(
+                    max_new_tokens=max_new,
+                    temperature=body.get("temperature", 0.0),
+                    top_k=body.get("top_k", 0),
+                    top_p=body.get("top_p", 0.0),
+                    seed=body.get("seed", 0),
+                    deadline=deadline,
                 )
-            result = self.state.complete(prompt, **kwargs)
+                if body.get("stream"):
+                    # validate (and pay the first device call) BEFORE the
+                    # 200 status goes out — errors must still be a 400
+                    finish: dict = {}
+                    cancel = threading.Event()
+                    pieces = st.stream(prompt, finish=finish,
+                                       cancel=cancel, **kwargs)
+                    first = next(pieces, None)
+                    TTFT_SECONDS.observe(time.monotonic() - self._t0)
+                    stream_ctx = (first, pieces, finish, cancel)
+                else:
+                    result = st.complete(prompt, **kwargs)
+        except Overloaded as e:
+            # load shed: the client's retry policy absorbs the spike
+            return self._json(429, {"error": str(e)}, headers={
+                "Retry-After": str(e.retry_after_s)})
+        except DeadlineExceeded as e:
+            return self._json(504, {"error": str(e)})
+        except Draining as e:
+            return self._json(503, {"error": str(e)},
+                              headers={"Retry-After": "1"})
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             # TypeError covers wrong-typed JSON fields (e.g. top_k: [1])
             # — a malformed request must be a 400, not a dropped socket
             return self._json(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — a generation failure
+            # (injected or organic) must be a JSON 500 the client can
+            # parse, not a dropped socket
+            log.warn(f"request failed: {type(e).__name__}: {e}")
+            return self._json(500, {
+                "error": f"{type(e).__name__}: {e}"})
+        if stream_ctx is not None:
+            first, pieces, finish, cancel = stream_ctx
+            with st._track_busy():
+                return self._stream_sse(
+                    first, pieces, chat=chat, finish=finish,
+                    cancel=cancel,
+                )
         if chat:
             return self._json(200, {
                 "id": f"chatcmpl-{uuid.uuid4().hex}",
@@ -1919,7 +2294,8 @@ class _Handler(BaseHTTPRequestHandler):
         return self._json(200, result)
 
     def _stream_sse(self, first: str | None, pieces, chat: bool,
-                    finish: dict | None = None) -> None:
+                    finish: dict | None = None,
+                    cancel: threading.Event | None = None) -> None:
         """Write text pieces as Server-Sent Events (``data: {json}``
         frames, terminal ``data: [DONE]`` — what OpenAI streaming
         clients parse) WITHOUT coupling the chip to the client: a
@@ -2002,9 +2378,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._write_raw(b"data: [DONE]\n\n")
                 self.wfile.write(b"0\r\n\r\n")
         except (BrokenPipeError, ConnectionResetError):
-            # client went away mid-stream; the producer finishes its
-            # bounded work and releases the lock on its own
-            log.info("server: client disconnected mid-stream")
+            # client went away mid-stream: cancel tells the generation
+            # loop to stop paying for tokens nobody reads (the producer
+            # drains what's queued and releases the lock on its own)
+            if cancel is not None:
+                cancel.set()
+            CANCELLED_TOTAL.labels("disconnect").inc()
+            log.info("server: client disconnected mid-stream — "
+                     "generation cancelled")
         finally:
             if producer is not None:
                 producer.join()
@@ -2056,7 +2437,10 @@ def make_server(env: dict | None = None) -> ThreadingHTTPServer:
     handler = type("Handler", (_Handler,), {"state": state})
     host = env.get("SERVER_HOST", "127.0.0.1")
     port = int(env.get("SERVER_PORT", "8000"))
-    return ThreadingHTTPServer((host, port), handler)
+    server = ThreadingHTTPServer((host, port), handler)
+    # the drain worker shuts this listener down once quiesced
+    state._http_server = server
+    return server
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -2095,10 +2479,23 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit(f"config error: {e}") from e
     host, port = server.server_address[:2]
     log.info(f"server: listening on {host}:{port}")
+    # SIGTERM (what Kubernetes sends ahead of SIGKILL) drains instead of
+    # dying mid-generation: admission stops, resident slots finish, the
+    # listener closes, serve_forever returns, and the process exits 0
+    # inside the terminationGracePeriod
+    import signal
+
+    state = server.RequestHandlerClass.state
+    signal.signal(
+        signal.SIGTERM, lambda signum, frame: state.begin_drain("SIGTERM")
+    )
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
+    if state.drain.is_draining:
+        state.drain.wait_drained(timeout=5)
+        log.info("server: exiting after drain")
     return 0
 
 

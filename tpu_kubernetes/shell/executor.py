@@ -29,10 +29,35 @@ from pathlib import Path
 from typing import Any, Sequence
 
 from tpu_kubernetes.obs import REGISTRY
+from tpu_kubernetes.obs.faults import FAULTS
 from tpu_kubernetes.state import State
 from tpu_kubernetes.util.trace import TRACER, Tracer
 
 STATE_FILE = "main.tf.json"
+
+# failure-message substrings that mark a terraform run as TRANSIENT —
+# worth a bounded retry instead of failing the workflow. Lock contention
+# and network blips are the classes the reference provisioner's users
+# hit in practice; "injected fault" is the fault harness
+# (obs/faults.py, site "shell.terraform") emulating exactly such a blip.
+# Classification is best-effort on the error text (with stream_output
+# the subprocess detail goes to the console, not the exception).
+TRANSIENT_PATTERNS = (
+    "state lock",
+    "lock info",
+    "connection reset",
+    "connection refused",
+    "temporary failure",
+    "tls handshake",
+    "rate limit",
+    "throttl",
+    "injected fault",
+)
+
+
+def is_transient(err: Exception) -> bool:
+    msg = str(err).lower()
+    return any(p in msg for p in TRANSIENT_PATTERNS)
 
 # terraform command telemetry (persisted into run reports, util/runlog.py):
 # init/apply/destroy/output durations and failure counts are THE create→
@@ -45,6 +70,13 @@ TF_SECONDS = REGISTRY.histogram(
 TF_FAILURES = REGISTRY.counter(
     "tpu_tf_failures_total",
     "terraform commands that exited nonzero (or failed to spawn)",
+    labelnames=("command",),
+)
+TF_RETRIES = REGISTRY.counter(
+    "tpu_tf_retries_total",
+    "transient terraform failures retried (lock/network classes) — "
+    "rides run reports via the tpu_tf_ prefix (util/runlog.py), so a "
+    "flaky-but-recovered apply is visible after the fact",
     labelnames=("command",),
 )
 
@@ -103,24 +135,52 @@ class TerraformExecutor(Executor):
         tracer: Tracer | None = None,
         stream_output: bool = True,
         timeout_s: float = 0.0,
+        retries: int = 2,
+        retry_backoff_s: float = 0.5,
     ):
         self.terraform_bin = terraform_bin
         self.tracer = tracer or TRACER
         self.stream_output = stream_output
         # 0 = no deadline; set to bound a wedged terraform apply
         self.timeout_s = timeout_s
+        # bounded retries for TRANSIENT failures only (is_transient) —
+        # timeouts and real config/plan errors fail on the first attempt
+        self.retries = max(0, int(retries))
+        self.retry_backoff_s = max(0.0, float(retry_backoff_s))
 
     def _run(self, args: Sequence[str], cwd: Path) -> None:
         """Stream a subprocess through (reference: shell/run_shell_cmd.go:8-13)
         via the native C++ runner when built (tpu_kubernetes/native — adds
         deadline enforcement and an output tail in errors), else plain
-        subprocess."""
+        subprocess. Transient failures (lock contention, network blips —
+        is_transient) retry up to ``retries`` times with exponential
+        backoff; only the FINAL failure counts in tpu_tf_failures_total,
+        recovered attempts count in tpu_tf_retries_total."""
         cmd = [self.terraform_bin, *args]
         from tpu_kubernetes.util import log
 
         log.debug(f"exec: {' '.join(cmd)} (cwd {cwd})")
-        with _tf_timed(args[0]):
-            self._run_inner(cmd, cwd)
+        command = args[0]
+        with _tf_timed(command):
+            attempt = 0
+            while True:
+                try:
+                    FAULTS.fire("shell.terraform")
+                    self._run_inner(cmd, cwd)
+                    return
+                except Exception as e:  # noqa: BLE001 — reclassified below
+                    if attempt >= self.retries or not is_transient(e):
+                        raise
+                    attempt += 1
+                    TF_RETRIES.labels(command).inc()
+                    delay = self.retry_backoff_s * 2.0 ** (attempt - 1)
+                    log.warn(
+                        f"terraform {command}: transient failure "
+                        f"(attempt {attempt}/{self.retries}, retrying "
+                        f"in {delay:.1f}s): {e}"
+                    )
+                    if delay:
+                        time.sleep(delay)
 
     def _run_inner(self, cmd: list[str], cwd: Path) -> None:
         from tpu_kubernetes import native
@@ -277,7 +337,14 @@ def default_executor() -> Executor:
     """Real terraform if present on PATH, else a fake (dry-run) executor with
     a loud warning — lets the whole CLI be exercised hermetically."""
     if shutil.which(os.environ.get("TPU_K8S_TERRAFORM_BIN", "terraform")):
-        return TerraformExecutor(os.environ.get("TPU_K8S_TERRAFORM_BIN", "terraform"))
+        # TPU_K8S_TF_TIMEOUT_S bounds a wedged command (0 = no deadline);
+        # TPU_K8S_TF_RETRIES bounds transient-failure retries
+        return TerraformExecutor(
+            os.environ.get("TPU_K8S_TERRAFORM_BIN", "terraform"),
+            timeout_s=float(os.environ.get("TPU_K8S_TF_TIMEOUT_S", "0")
+                            or 0),
+            retries=int(os.environ.get("TPU_K8S_TF_RETRIES", "2") or 0),
+        )
     import sys
 
     print(
