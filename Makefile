@@ -3,7 +3,7 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast obs-check monitor-check perf-check serve-identity-check serve-continuous-check resilience-check bench dryrun native dist dist-offline clean
+.PHONY: test test-fast obs-check monitor-check perf-check goodput-check serve-identity-check serve-continuous-check resilience-check bench dryrun native dist dist-offline clean
 
 test:
 	python -m pytest tests/ -q
@@ -47,12 +47,25 @@ perf-check:
 	  --check --baseline benchmarks/baseline.jsonl --threshold 5.0 \
 	  --n 3 --warmup 2 --require-baseline
 
-# Quick pre-commit identity gate for the serve hot path: only the greedy
+# Goodput/MFU gate: the token ledger (classes, conservation per serve
+# path, slot-engine timeline + bubble fraction), the analytical
+# roofline (FLOPs/token exact on CPU, utilization null), the
+# /debug/ledger + `get goodput` + monitor GOODPUT surfaces, and the
+# conservation-under-chaos matrix (docs/guide/observability.md
+# "Goodput & MFU").
+goodput-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_ledger.py \
+	  "tests/test_faults.py::test_chaos_ledger_conservation" \
+	  -q -m "not slow"
+
+# Quick pre-commit identity gate for the serve hot path: the greedy
 # token-identity tests (warm-prefix vs cold prefill, early-exit vs
-# run-to-max decode, batched/continuous vs solo — fp32 and int8 KV cache).
+# run-to-max decode, batched/continuous vs solo — fp32 and int8 KV
+# cache) plus the ledger-conservation identity tests for the same paths.
 serve-identity-check:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py \
 	  tests/test_serve_prefix.py tests/test_serve_continuous.py \
+	  tests/test_ledger.py \
 	  -q -m "not slow" -k identity
 
 # Continuous-batching gate: the slot-engine unit + e2e tests, the full

@@ -11,6 +11,7 @@ Fleet-scrape and terraform sites are chaos-tested in their own suites
 
 import json
 import threading
+import time
 
 import pytest
 
@@ -181,6 +182,35 @@ def test_chaos_every_request_terminates(chaos_server, site, prob):
     # chaos over: the same engine serves clean traffic immediately
     ok = state.complete("pack my box", max_new_tokens=3)
     assert ok["text"]
+
+
+@pytest.mark.parametrize("prob", [1.0, 0.5])
+@pytest.mark.parametrize("site", SERVE_SITES)
+def test_chaos_ledger_conservation(chaos_server, site, prob):
+    """The goodput ledger's conservation invariant under chaos: every
+    decoded token lands in exactly one class, so the classes sum to
+    tokens emitted even while faults shed requests, fail residents out,
+    and abort mid-decode — nothing counted twice, nothing dropped."""
+    from tpu_kubernetes.obs.ledger import LEDGER
+
+    state = chaos_server.RequestHandlerClass.state
+    before = LEDGER.snapshot(timeline=0)
+    with injected(f"{site}:{prob}:11"):
+        _fan_out_chaotic(state, PROMPTS)
+    # chaos over: one clean request drains the engine, then settlement
+    # (engine-thread reaps/fail-outs) converges back to the unsettled
+    # floor the session started this test with (delta form — an earlier
+    # test using the engine's private API may leave a fixed floor)
+    state.complete("pack my box", max_new_tokens=3)
+    deadline = time.time() + 10
+    while (time.time() < deadline
+           and LEDGER.unsettled() != before["unsettled"]):
+        time.sleep(0.02)
+    after = LEDGER.snapshot(timeline=0)
+    assert after["unsettled"] == before["unsettled"]
+    assert (sum(after["classes"].values()) - sum(before["classes"].values())
+            == after["emitted"] - before["emitted"])
+    assert after["emitted"] > before["emitted"]      # traffic was counted
 
 
 def test_chaos_http_surface_stays_consistent(chaos_server):

@@ -392,6 +392,49 @@ def test_event_sink_rotation_disabled_and_env(tmp_path, monkeypatch):
     )
 
 
+def test_event_sink_keeps_n_generations(tmp_path):
+    path = tmp_path / "events.jsonl"
+    sink = events.EventSink(path=str(path), max_bytes=120, keep=3)
+    for i in range(60):
+        sink.write({"kind": "tick", "i": i})
+    gens = [tmp_path / f"events.jsonl.{n}" for n in (1, 2, 3)]
+    assert all(g.exists() for g in gens)
+    assert not (tmp_path / "events.jsonl.4").exists()   # capped at keep
+    # generations stay ordered: .1 newer than .2 newer than .3, live newest
+    def first_i(p):
+        return json.loads(p.read_text().splitlines()[0])["i"]
+    order = [first_i(p) for p in (path, *gens)]
+    assert order == sorted(order, reverse=True)
+    # every surviving line is whole (cascade lands on line boundaries)
+    for p in (path, *gens):
+        assert all(json.loads(ln)["kind"] == "tick"
+                   for ln in p.read_text().splitlines())
+
+
+def test_event_sink_keep_prunes_stale_generations(tmp_path, monkeypatch):
+    path = tmp_path / "events.jsonl"
+    # a previous run with a larger keep left generations behind
+    for n in (1, 2, 3, 4, 5):
+        (tmp_path / f"events.jsonl.{n}").write_text('{"old": %d}\n' % n)
+    sink = events.EventSink(path=str(path), max_bytes=60, keep=2)
+    for i in range(10):
+        sink.write({"kind": "tick", "i": i})
+    # prune-on-write: the lowered keep retires .3/.4/.5
+    assert not any(
+        (tmp_path / f"events.jsonl.{n}").exists() for n in (3, 4, 5))
+    assert (tmp_path / "events.jsonl.1").exists()
+
+    # env spelling, with bad values falling back like MAX_MB does
+    monkeypatch.setenv("TPU_K8S_EVENTS_KEEP", "4")
+    assert events.EventSink(path="x")._keep == 4
+    monkeypatch.setenv("TPU_K8S_EVENTS_KEEP", "junk")
+    assert events.EventSink(path="x")._keep == events.DEFAULT_KEEP
+    monkeypatch.setenv("TPU_K8S_EVENTS_KEEP", "0")     # floor of 1
+    assert events.EventSink(path="x")._keep == 1
+    monkeypatch.delenv("TPU_K8S_EVENTS_KEEP")
+    assert events.EventSink(path="x")._keep == events.DEFAULT_KEEP
+
+
 def test_event_sink_rotation_failure_swallowed(tmp_path, monkeypatch):
     path = tmp_path / "events.jsonl"
     sink = events.EventSink(path=str(path), max_bytes=50)

@@ -75,16 +75,19 @@ def build_parser() -> argparse.ArgumentParser:
     get = sub.add_parser(
         "get",
         help="query a manager or cluster, fetch a kubeconfig, list "
-             "recorded workflow runs, dump in-process metrics, or render "
-             "a serving worker's phase-profile breakdown",
+             "recorded workflow runs, dump in-process metrics, render "
+             "a serving worker's phase-profile breakdown, or its "
+             "goodput ledger",
     )
     get.add_argument(
         "kind",
         choices=["manager", "cluster", "kubeconfig", "runs", "metrics",
-                 "profile"],
+                 "profile", "goodput"],
         help="profile renders the worker's phase table — cold (prefill) "
              "vs warm (prefill_warm) prefills split out, so prefix-cache "
-             "savings are read off one row pair",
+             "savings are read off one row pair; goodput renders the "
+             "token ledger (useful/cancelled/expired/shed-spent/bubble), "
+             "slot-engine bubble fraction, and analytical MFU/roofline",
     )
     get.add_argument(
         "--manager", metavar="NAME",
@@ -92,11 +95,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     get.add_argument(
         "--json", dest="as_json", action="store_true",
-        help="with runs/profile: dump the raw JSON instead of the table",
+        help="with runs/profile/goodput: dump the raw JSON instead of "
+             "the table",
     )
     get.add_argument(
         "--target", metavar="HOST:PORT", default="127.0.0.1:8000",
-        help="with profile: the serving worker to query "
+        help="with profile/goodput: the serving worker to query "
              "(default 127.0.0.1:8000)",
     )
 
@@ -257,6 +261,23 @@ def main(argv: list[str] | None = None) -> int:
             print(json.dumps(data, indent=2, sort_keys=True))
         else:
             print(render_profile(data), end="")
+        return 0
+
+    if args.command == "get" and args.kind == "goodput":
+        # a remote worker's GET /debug/ledger, rendered — same stance as
+        # get profile: no backend, config, or prompts involved
+        from tpu_kubernetes.obs.ledger import fetch_ledger, render_ledger
+
+        try:
+            data = fetch_ledger(args.target)
+        except Exception as e:  # noqa: BLE001 — network errors → exit 1
+            print(f"error: cannot fetch ledger from {args.target}: {e}",
+                  file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps(data, indent=2, sort_keys=True))
+        else:
+            print(render_ledger(data), end="")
         return 0
 
     if args.command == "get" and args.kind == "metrics":

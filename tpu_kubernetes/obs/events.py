@@ -51,6 +51,7 @@ def current_span_id() -> str | None:
 
 
 DEFAULT_MAX_MB = 64.0
+DEFAULT_KEEP = 1
 
 
 def _max_bytes_from_env() -> int:
@@ -61,23 +62,35 @@ def _max_bytes_from_env() -> int:
     return int(mb * 1024 * 1024)
 
 
+def _keep_from_env() -> int:
+    try:
+        keep = int(os.environ.get("TPU_K8S_EVENTS_KEEP", "") or DEFAULT_KEEP)
+    except ValueError:
+        keep = DEFAULT_KEEP
+    return max(1, keep)
+
+
 class EventSink:
     """Thread-safe JSONL writer over a path or an open stream.
 
     Path sinks rotate by size so a long-lived server cannot fill a disk:
     when the file would exceed ``max_bytes`` (``TPU_K8S_EVENTS_MAX_MB``,
-    default 64; ≤0 disables) it is renamed to ``<path>.1`` — one
-    generation of history, always at a line boundary — and the stream
-    starts fresh. Rotation failures are swallowed like every other sink
-    failure: observability must not fail the workflow."""
+    default 64; ≤0 disables) generations cascade ``<path>.N-1 →
+    <path>.N`` up to ``keep`` rotations (``TPU_K8S_EVENTS_KEEP``,
+    default 1) — always at a line boundary — and the stream starts
+    fresh; stale generations beyond ``keep`` are pruned on write, the
+    same retention discipline as runs/ (util/runlog.py). Rotation
+    failures are swallowed like every other sink failure: observability
+    must not fail the workflow."""
 
     def __init__(self, path: str | None = None, stream: io.IOBase | None = None,
-                 max_bytes: int | None = None):
+                 max_bytes: int | None = None, keep: int | None = None):
         self._path = path
         self._stream = stream
         self._max_bytes = (
             _max_bytes_from_env() if max_bytes is None else int(max_bytes)
         )
+        self._keep = max(1, _keep_from_env() if keep is None else int(keep))
         self._lock = threading.Lock()
 
     def _maybe_rotate(self, incoming: int) -> None:
@@ -85,6 +98,17 @@ class EventSink:
             return
         try:
             if os.path.getsize(self._path) + incoming > self._max_bytes:
+                # prune-on-write: a keep lowered between runs retires
+                # generations the old setting left behind
+                i = self._keep + 1
+                while os.path.exists(f"{self._path}.{i}"):
+                    os.remove(f"{self._path}.{i}")
+                    i += 1
+                # cascade oldest-first so every survivor shifts one slot
+                for i in range(self._keep, 1, -1):
+                    older = f"{self._path}.{i - 1}"
+                    if os.path.exists(older):
+                        os.replace(older, f"{self._path}.{i}")
                 os.replace(self._path, f"{self._path}.1")
         except OSError:
             pass  # no file yet, or rename refused — keep appending

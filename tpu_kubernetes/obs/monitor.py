@@ -27,6 +27,8 @@ REQUESTS = "tpu_serve_requests_total"
 LATENCY = "tpu_serve_request_seconds"
 TTFT = "tpu_serve_time_to_first_token_seconds"
 TOKENS = "tpu_serve_tokens_generated_total"
+TOKENS_CLASS = "tpu_serve_tokens_total"
+TOKENS_EMITTED = "tpu_serve_tokens_emitted_total"
 INFLIGHT = "tpu_serve_inflight_requests"
 BUILD_INFO = "tpu_k8s_build_info"
 
@@ -46,6 +48,15 @@ def fleet_rows(snapshot: FleetSnapshot,
         mine = _of_instance(instance)
         requests = snapshot.value_sum(REQUESTS, mine)
         tokens = snapshot.value_sum(TOKENS, mine)
+        # goodput: the ledger's useful share of every token the device
+        # produced (obs/ledger.py conservation classes) — None until the
+        # worker has emitted anything
+        emitted = snapshot.value_sum(TOKENS_EMITTED, mine)
+        useful = snapshot.value_sum(
+            TOKENS_CLASS,
+            lambda labels: (labels.get("instance") == instance
+                            and labels.get("class") == "useful"),
+        )
         row: dict[str, Any] = {
             "instance": instance,
             "up": health.up,
@@ -63,6 +74,7 @@ def fleet_rows(snapshot: FleetSnapshot,
             "p99_s": snapshot.quantile(LATENCY, 0.99, mine),
             "ttft_p99_s": snapshot.quantile(TTFT, 0.99, mine),
             "queue_depth": snapshot.value_sum(INFLIGHT, mine),
+            "goodput": round(useful / emitted, 4) if emitted else None,
         }
         if prev is not None and instance in prev.health:
             row["rps"] = rate(
@@ -91,7 +103,7 @@ def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
     pending/firing alerts."""
     header = (
         f"{'INSTANCE':<24} {'UP':>2} {'VER':>8} {'RPS':>8} {'P50':>8} "
-        f"{'P99':>8} {'TTFT99':>8} {'TOK/S':>8} {'QUEUE':>6}"
+        f"{'P99':>8} {'TTFT99':>8} {'TOK/S':>8} {'QUEUE':>6} {'GOODPUT':>8}"
     )
     lines = []
     if ts is not None:
@@ -109,6 +121,7 @@ def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
             f"{_fmt(row['ttft_p99_s'], 's', 9)}"
             f"{_fmt(row['tokens_per_s'])}"
             f"{_fmt(int(row['queue_depth']), '', 7)}"
+            f"{_fmt(row.get('goodput'), '', 9)}"
         )
         if not row["up"] and row["error"]:
             lines.append(

@@ -641,6 +641,78 @@ def _fmt_s(v: float | None) -> str:
     return f"{v * 1e3:9.3f}ms" if v is not None else "        — "
 
 
+def _goodput_info(results: dict[str, BenchResult]) -> dict[str, Any] | None:
+    """Report-only goodput/MFU attribution for serve runs: FLOPs and
+    bytes per token from compiled-program ``cost_analysis`` over the
+    same prefill/decode-step programs the serve benches time, MFU =
+    flops / (measured median × backend peak) — ``None`` on CPU while
+    FLOPs/token stays exact — plus the in-process token ledger's
+    goodput counters (obs/ledger.py). Deliberately NEVER part of
+    ``results``/history: these are attributions, not timings, so
+    baselines and ``--require-baseline`` are unaffected."""
+    serve = {n: r for n, r in results.items() if r.suite == "serve"}
+    if not serve:
+        return None
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_kubernetes.models import CONFIGS, init_params
+        from tpu_kubernetes.models.decode import decode_step, prefill
+        from tpu_kubernetes.obs.ledger import LEDGER
+        from tpu_kubernetes.obs.profile import (
+            backend_peak_flops,
+            device_kind,
+            program_cost,
+        )
+
+        cfg = CONFIGS[_TEST_MODEL]
+        params = init_params(jax.random.PRNGKey(3), cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(4), (2, 16), 0, cfg.vocab_size, jnp.int32)
+        pf = jax.jit(lambda p, t: prefill(p, t, cfg, max_seq=64)[0])
+        _, cache = prefill(params, tokens, cfg, max_seq=64)
+        tok = jnp.array([1, 2], jnp.int32)
+        ds = jax.jit(lambda p, c, t: decode_step(p, c, t, cfg)[0])
+        costs = {
+            "serve.prefill": (program_cost(pf, params, tokens),
+                              int(tokens.size)),
+            "serve.decode_step": (program_cost(ds, params, cache, tok),
+                                  int(tok.size)),
+        }
+        snap = LEDGER.snapshot(timeline=0)
+    except Exception:  # noqa: BLE001 — report-only: never fail the run
+        return None
+    kind = device_kind()
+    peak = backend_peak_flops(kind)
+    programs: dict[str, Any] = {}
+    for name, (cost, n_tok) in costs.items():
+        if cost is None or not n_tok:
+            continue
+        flops, nbytes = cost.get("flops"), cost.get("bytes")
+        median = serve[name].median_seconds if name in serve else None
+        programs[name] = {
+            "flops_per_token": round(flops / n_tok, 3) if flops else None,
+            "bytes_per_token": round(nbytes / n_tok, 3) if nbytes else None,
+            "arithmetic_intensity": (round(flops / nbytes, 3)
+                                     if flops and nbytes else None),
+            "mfu": (round(flops / (median * peak), 6)
+                    if flops and median and peak else None),
+        }
+    if not programs:
+        return None
+    return {
+        "device_kind": kind,
+        "peak_flops": peak,
+        "programs": programs,
+        "ledger": {
+            "emitted": snap["emitted"],
+            "goodput": snap["goodput"],
+            "bubble_fraction": snap["slot_engine"]["bubble_fraction"],
+        },
+    }
+
+
 def run(suite: str = "all", *, check: bool = False, as_json: bool = False,
         history_dir: str = DEFAULT_HISTORY_DIR, baseline: str | None = None,
         threshold: float = DEFAULT_THRESHOLD, n: int = 5, warmup: int = 2,
@@ -709,6 +781,10 @@ def run(suite: str = "all", *, check: bool = False, as_json: bool = False,
         if report:
             reports.append(report)
 
+    goodput = _goodput_info(all_results)
+    if goodput is not None:
+        payload["goodput"] = goodput
+
     missing = [c for r in reports for c in r.checks
                if c.status == "missing"]
     rc = 0
@@ -735,6 +811,22 @@ def run(suite: str = "all", *, check: bool = False, as_json: bool = False,
         elif c:
             line += f"  {c.status}"
         print(line, file=out)
+    if goodput is not None:
+        peak = goodput["peak_flops"]
+        print(f"goodput/MFU (report-only)   device "
+              f"{goodput['device_kind'] or 'unknown'}  peak-flops "
+              f"{'null' if peak is None else f'{peak:.3g}'}", file=out)
+        for name, prog in sorted(goodput["programs"].items()):
+            ft, mfu = prog["flops_per_token"], prog["mfu"]
+            print(f"  {name:<22} flops/tok "
+                  f"{'—' if ft is None else f'{ft:.4g}'}"
+                  f"  intensity {prog['arithmetic_intensity']}"
+                  f"  mfu {'null' if mfu is None else mfu}", file=out)
+        led = goodput["ledger"]
+        gp, bf = led["goodput"], led["bubble_fraction"]
+        print(f"  ledger: emitted {led['emitted']}"
+              f"  goodput {'—' if gp is None else gp}"
+              f"  bubble {'—' if bf is None else bf}", file=out)
     for c in checks_by_name.values():
         if c.status == "missing":
             print(f"{c.name:<24} missing from this run "

@@ -81,6 +81,74 @@ def device_memory_stats():
     return out or None
 
 
+# analytical roofline: bf16 dense peak FLOP/s per accelerator, matched
+# by substring against jax's device_kind (v5e reports "TPU v5 lite").
+# CPU and unknown backends get None — utilization degrades to null
+# while FLOPs/token (an XLA cost-model fact) stays exact everywhere.
+PEAK_FLOPS = (
+    ("v6e", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v5e", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("h100", 989e12),
+    ("a100", 312e12),
+)
+
+
+def device_kind() -> str | None:
+    """The first addressable device's kind string ("cpu", "TPU v5e",
+    ...), or ``None`` when jax is unavailable."""
+    try:
+        import jax
+        return str(jax.devices()[0].device_kind)
+    except Exception:
+        return None
+
+
+def backend_peak_flops(kind: str | None = None) -> float | None:
+    """Dense bf16 peak FLOP/s for the backend, or ``None`` on CPU /
+    unknown hardware (same graceful-degradation stance as
+    :func:`device_memory_stats`)."""
+    kind = kind if kind is not None else device_kind()
+    if not kind:
+        return None
+    k = kind.lower()
+    if "cpu" in k:
+        return None
+    for sub, peak in PEAK_FLOPS:
+        if sub in k:
+            return peak
+    return None
+
+
+def program_cost(fn, *args, **kwargs) -> dict | None:
+    """XLA's analytical cost model for one call of jitted ``fn``:
+    ``{"flops", "bytes"}`` per invocation, via ``lower().cost_analysis()``
+    (a trace, not a compile — and crucially not a *call*, so donated
+    buffers stay alive for the real invocation that follows). Returns
+    ``None`` when the backend or program doesn't report costs."""
+    try:
+        lowered = fn.lower(*args, **kwargs)
+        cost = lowered.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(cost, (list, tuple)):   # older jax: list of dicts
+        cost = cost[0] if cost else None
+    if not isinstance(cost, dict):
+        return None
+    out = {}
+    flops = cost.get("flops")
+    if flops is not None and float(flops) > 0:
+        out["flops"] = float(flops)
+    nbytes = cost.get("bytes accessed")
+    if nbytes is not None and float(nbytes) > 0:
+        out["bytes"] = float(nbytes)
+    return out or None
+
+
 @dataclass
 class PhaseHandle:
     """Yielded by :meth:`PhaseProfiler.phase`; call :meth:`sync` on the
@@ -161,6 +229,11 @@ class PhaseProfiler:
         self._records: deque[PhaseRecord] = deque(maxlen=max_records)
         self._last_hbm: dict | None = None
         self.sample_hbm = sample_hbm
+        # analytical roofline: per-phase {flops, bytes, tokens} from the
+        # last captured program (record_cost), keyed like phases so
+        # utilization can divide by that phase's execute-mode mean
+        self._cost_seen: set = set()
+        self._costs: dict[str, dict] = {}
 
     def mark_first(self, name: str, key=None) -> bool:
         """Check-and-mark first-call status for ``(name, key)`` without
@@ -238,6 +311,68 @@ class PhaseProfiler:
             if hbm:
                 self._last_hbm = hbm
 
+    def record_cost(self, name: str, fn, args=(), kwargs=None, *,
+                    tokens: int | None = None, key=None) -> None:
+        """Capture XLA's analytical FLOPs/bytes for program ``(name,
+        key)`` — once, on its first sighting, and BEFORE the program's
+        first call (lowering needs the concrete args, and donated
+        buffers are deleted by the call). ``tokens`` is how many tokens
+        one invocation produces/consumes, making FLOPs-per-token exact
+        even on backends with no peak-FLOPs entry."""
+        k = (name, key)
+        with self._lock:
+            if k in self._cost_seen:
+                return
+            self._cost_seen.add(k)
+        cost = program_cost(fn, *args, **(kwargs or {}))
+        if cost is None:
+            return
+        entry = {
+            "flops": cost.get("flops"),
+            "bytes": cost.get("bytes"),
+            "tokens": int(tokens) if tokens else None,
+        }
+        with self._lock:
+            self._costs[name] = entry
+
+    def roofline(self) -> dict:
+        """Analytical MFU/roofline attribution: per captured program,
+        FLOPs/bytes per token and arithmetic intensity (exact from the
+        XLA cost model, CPU included), plus utilization = achieved
+        FLOP/s over the backend's dense peak — ``None`` whenever the
+        peak (CPU) or a steady-state execute mean is unknown."""
+        kind = device_kind()
+        peak = backend_peak_flops(kind)
+        with self._lock:
+            costs = {n: dict(d) for n, d in self._costs.items()}
+            exec_means = {
+                name: s.total / s.count
+                for (name, mode), s in self._stats.items()
+                if mode == EXECUTE and s.count
+            }
+        programs: dict[str, dict] = {}
+        for name in sorted(costs):
+            d = costs[name]
+            flops, nbytes, toks = d["flops"], d["bytes"], d["tokens"]
+            prog = {
+                "flops": flops,
+                "bytes": nbytes,
+                "tokens_per_call": toks,
+                "flops_per_token": (round(flops / toks, 3)
+                                    if flops and toks else None),
+                "bytes_per_token": (round(nbytes / toks, 3)
+                                    if nbytes and toks else None),
+                "arithmetic_intensity": (round(flops / nbytes, 3)
+                                         if flops and nbytes else None),
+                "utilization": None,
+            }
+            mean = exec_means.get(name)
+            if peak and flops and mean and mean > 0:
+                prog["utilization"] = round(flops / (mean * peak), 6)
+            programs[name] = prog
+        return {"device_kind": kind, "peak_flops": peak,
+                "programs": programs}
+
     def stat(self, name: str, mode: str) -> dict | None:
         with self._lock:
             s = self._stats.get((name, mode))
@@ -273,7 +408,8 @@ class PhaseProfiler:
                 # the trace+compile overhead this profiler exists to expose
                 modes["compile_overhead_seconds"] = round(
                     max(0.0, comp["last_seconds"] - execu["mean_seconds"]), 6)
-        return {"metric": self.metric, "phases": phases, "hbm": hbm}
+        return {"metric": self.metric, "phases": phases, "hbm": hbm,
+                "roofline": self.roofline()}
 
     def reset(self) -> None:
         """Drop first-call marks, aggregates and records (tests)."""
@@ -282,6 +418,8 @@ class PhaseProfiler:
             self._stats.clear()
             self._records.clear()
             self._last_hbm = None
+            self._cost_seen.clear()
+            self._costs.clear()
 
 
 def render_profile(summary: dict) -> str:
@@ -312,6 +450,25 @@ def render_profile(summary: dict) -> str:
     if hbm:
         parts = [f"{k}={v / 2**20:.1f}MiB" for k, v in sorted(hbm.items())]
         lines.append("hbm: " + " ".join(parts))
+    roof = summary.get("roofline") or {}
+    progs = roof.get("programs") or {}
+    if progs:
+        peak = roof.get("peak_flops")
+        lines.append(
+            f"roofline (device={roof.get('device_kind') or 'unknown'} "
+            f"peak_flops={f'{peak:.3g}' if peak else 'none'}):")
+        lines.append(
+            f"{'PROGRAM':<12} {'FLOPS/TOK':>12} {'BYTES/TOK':>12} "
+            f"{'INTENSITY':>10} {'MFU':>8}")
+        for name in sorted(progs):
+            d = progs[name]
+            fmt = lambda v: "—" if v is None else format(v, ".3g")  # noqa: E731
+            util = d.get("utilization")
+            lines.append(
+                f"{name:<12} {fmt(d.get('flops_per_token')):>12} "
+                f"{fmt(d.get('bytes_per_token')):>12} "
+                f"{fmt(d.get('arithmetic_intensity')):>10} "
+                f"{'null' if util is None else format(util, '.2%'):>8}")
     return "\n".join(lines) + "\n"
 
 

@@ -118,6 +118,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from tpu_kubernetes.obs import REGISTRY, events
 from tpu_kubernetes.obs import metrics as obs_metrics
 from tpu_kubernetes.obs.faults import FAULTS
+from tpu_kubernetes.obs.ledger import LEDGER
 from tpu_kubernetes.obs.profile import PhaseProfiler
 from tpu_kubernetes.serve.resilience import (
     CANCELLED_TOTAL,
@@ -504,6 +505,10 @@ class _ContinuousEngine:
         self._ps = np.zeros(slots, np.int32)
         self.recycled = 0
         self.restarts = 0
+        # per-segment timeline feed: admissions/reaps since the last
+        # segment record (scheduler-thread-only, like the slot arrays)
+        self._last_admitted = 0
+        self._last_reaped = 0
         self._cache = init_cache(
             state.cfg, slots, self.span, kv_quant=state.kv_quant
         )
@@ -583,19 +588,28 @@ class _ContinuousEngine:
             cancel = entry.get("cancel")
             if cancel is not None and cancel.is_set():
                 CANCELLED_TOTAL.labels("engine").inc()
+                cls = "cancelled"
                 entry["error"] = Cancelled(
                     "request cancelled — slot retired mid-flight"
                 )
             elif expired(entry.get("deadline"), now):
                 DEADLINE_TOTAL.labels("resident").inc()
+                cls = "expired"
                 entry["error"] = DeadlineExceeded(
                     "deadline expired mid-decode — slot retired"
                 )
             else:
                 continue
+            # settle the row's decoded-but-undelivered tokens BEFORE
+            # _retire clears _collected — drop this and the chaos
+            # conservation test catches the leak
+            if self._state.ready:
+                LEDGER.settle(cls, len(self._collected[i]),
+                              device_s=entry.get("_device_s") or 0.0)
             entry["dispatched"].set()
             entry["event"].set()
             self._retire(i)
+            self._last_reaped += 1
             reaped = True
         if reaped:
             SLOT_OCCUPANCY.set(sum(e is not None for e in self._entries))
@@ -630,6 +644,12 @@ class _ContinuousEngine:
                 entry["error"] = e
                 entry["dispatched"].set()
                 entry["event"].set()
+                # a prefill (and possibly its sampled token) was spent
+                # on an entry that now fails out: shed-spent
+                if self._state.ready:
+                    LEDGER.settle("shed-spent",
+                                  entry.get("_decoded") or 0,
+                                  device_s=entry.get("_device_s") or 0.0)
                 # the graft may have half-landed: scrub the row so the
                 # slot the next admission reuses is bitwise cold
                 self._clear_row(free, best_effort=True)
@@ -644,6 +664,7 @@ class _ContinuousEngine:
         jax = st._jax
         ids, budget = entry["ids"], entry["budget"]
         width = _bucket(len(ids))
+        t0 = time.perf_counter()
         with st._lock:
             # per-row width bucket; span == width (zero generation
             # slots — decode happens in the engine cache, not the row
@@ -651,6 +672,12 @@ class _ContinuousEngine:
             # and the prefix store serves warm starts into slots too
             logits, row = st._prefill_any(ids, width, width)
             first = int(np.argmax(np.asarray(logits)[0]))
+            # production: the prefill's sampled token exists NOW — if
+            # the graft below fails, _admit settles it shed-spent via
+            # the _decoded mark (a pre-prefill fault produced nothing)
+            if st.ready:
+                LEDGER.emitted(1)
+            entry["_decoded"] = 1
             if budget <= 1 or (st.eos_id is not None
                                and first == st.eos_id):
                 # one-token budget or instant EOS: done without a slot
@@ -663,6 +690,7 @@ class _ContinuousEngine:
                     ),
                 )
                 self._cache = ins(self._cache, row, slot)
+        entry["_device_s"] = time.perf_counter() - t0
         wait = time.monotonic() - entry["t_enq"]
         ADMISSION_WAIT.observe(wait)
         st.admission.observe_service(wait)
@@ -677,6 +705,7 @@ class _ContinuousEngine:
         self._rem[slot] = budget - 1     # the first token is emitted
         self._pl[slot] = len(ids)
         self._ps[slot] = width
+        self._last_admitted += 1
         entry["dispatched"].set()
         SLOT_OCCUPANCY.set(sum(e is not None for e in self._entries))
 
@@ -713,30 +742,68 @@ class _ContinuousEngine:
             prompt_lengths=jnp.asarray(self._pl),
             prompt_slots=jnp.asarray(self._ps),
         )
+        occupied = sum(e is not None for e in self._entries)
+        row_steps = steps * self.slots
+        t0 = time.perf_counter()
         with st._lock:
+            PROFILER.record_cost(
+                "decode", seg, (st.params, self._cache, state),
+                tokens=row_steps, key=("slot_segment", steps),
+            )
             with PROFILER.phase(
                 "decode", key=("slot_segment", steps), tracer=TRACER,
             ) as pd:
                 toks, state, self._cache = pd.sync(
                     seg(st.params, self._cache, state)
                 )
+        elapsed = time.perf_counter() - t0
         toks = np.asarray(toks)
         new_pos = np.asarray(state.pos)
         old_pos, self._pos = self._pos, new_pos.copy()
         self._tok = np.asarray(state.tok).copy()
         self._rem = np.asarray(state.remaining).copy()
+        live = 0
         for i, entry in enumerate(self._entries):
             if entry is None:
                 continue
             # a row emitted exactly as many tokens as its pos advanced
             # (frozen rows never advance) — pads never reach results
             emitted = int(new_pos[i] - old_pos[i])
+            live += emitted
             self._collected[i].extend(toks[i][:emitted].tolist())
-            if self._rem[i] <= 0:
+            # apportion the segment's device time by advance share; the
+            # dead share (empty slots, frozen rows) settles as bubble
+            entry["_device_s"] = (entry.get("_device_s") or 0.0) + \
+                elapsed * emitted / row_steps
+        drained = 0
+        if st.ready:
+            # production: the device ran steps x slots row-steps; rows
+            # that advanced are settled by their terminal site, the rest
+            # (empty slots, done rows inside the segment) are bubble NOW
+            LEDGER.emitted(row_steps)
+            LEDGER.bubble(row_steps - live,
+                          device_s=elapsed * (row_steps - live) / row_steps)
+        for i, entry in enumerate(self._entries):
+            if entry is not None and self._rem[i] <= 0:
                 entry["tokens"] = self._collected[i]
                 entry["event"].set()
                 self._retire(i)
-        SLOT_OCCUPANCY.set(sum(e is not None for e in self._entries))
+                drained += 1
+        if st.ready:
+            LEDGER.segment(
+                steps=steps, slots=self.slots, occupied=occupied,
+                live_steps=live, admitted=self._last_admitted,
+                drained=drained, reaped=self._last_reaped,
+                seconds=elapsed,
+            )
+            self._last_admitted = 0
+            self._last_reaped = 0
+        # intra-segment occupancy: MEAN live rows across the segment's
+        # steps (a row that finishes mid-segment counts fractionally),
+        # not the between-segments resident count the old gauge showed;
+        # a fully drained engine still reads 0
+        resident = sum(e is not None for e in self._entries)
+        SLOT_OCCUPANCY.set(live / steps if resident else 0.0)
 
     def _clear_row(self, slot: int, best_effort: bool = False) -> None:
         """cache_clear_row slot ``slot`` back to bitwise-cold. With
@@ -778,6 +845,17 @@ class _ContinuousEngine:
         with self._cond:
             queued, self._queue = self._queue, []
         affected = queued + [e for e in self._entries if e is not None]
+        # settle BEFORE the wipe below destroys _collected: device work
+        # already spent on these entries (prefill token + segment
+        # advances) dies with the reset — the definition of shed-spent
+        if self._state.ready:
+            for e in queued:
+                LEDGER.settle("shed-spent", e.get("_decoded") or 0,
+                              device_s=e.get("_device_s") or 0.0)
+            for i, e in enumerate(self._entries):
+                if e is not None:
+                    LEDGER.settle("shed-spent", len(self._collected[i]),
+                                  device_s=e.get("_device_s") or 0.0)
         for i in range(self.slots):
             self._entries[i] = None
             self._collected[i] = []
@@ -1371,12 +1449,19 @@ class ServingState:
                 kv_quant=self.kv_quant,
             )),
         )
+        rows = jnp.asarray(padded)
+        lens = jnp.asarray(lengths, jnp.int32)
+        # analytical roofline: capture the program's FLOPs/bytes before
+        # its first call (lowering needs live concrete args)
+        PROFILER.record_cost(
+            "prefill", pf, (self.params, rows), {"lengths": lens},
+            tokens=int(rows.size), key=("prefill", span),
+        )
         with PROFILER.phase(
             "prefill", key=("prefill", span), tracer=TRACER,
         ) as pp:
             logits, cache = pp.sync(pf(
-                self.params, jnp.asarray(padded),
-                lengths=jnp.asarray(lengths, jnp.int32),
+                self.params, rows, lengths=lens,
             ))
         return logits, cache
 
@@ -1479,6 +1564,10 @@ class ServingState:
                     eos_id=eos, pad_id=0,
                 )),
             )
+            PROFILER.record_cost(
+                "decode", seg, (self.params, cache, tok, done),
+                tokens=b * steps, key=("segment", steps),
+            )
             with PROFILER.phase(
                 "decode", key=("segment", steps), tracer=TRACER,
             ) as pd:
@@ -1492,6 +1581,25 @@ class ServingState:
         if saved > 0 and self.ready:
             DECODE_STEPS_SAVED.inc(saved)
         return np.concatenate(pieces, axis=1), steps_run
+
+    def _ledger_batch(self, entries: list, produced: int, b: int,
+                      elapsed: float) -> None:
+        """Goodput accounting for one dispatched static batch: the
+        device produced ``produced`` tokens (the full (b, L) matrix);
+        what each entry takes home is settled by complete(), the rest —
+        pad rows, decode past a row's own budget — is bubble HERE, so
+        production and settlement sum at quiescence. Device seconds
+        split evenly across rows (pad rows' share is bubble)."""
+        handed = 0
+        for e in entries:
+            handed += len(e["tokens"])
+            e["_device_s"] = elapsed / max(1, b)
+        if self.ready:
+            LEDGER.emitted(produced)
+            LEDGER.bubble(
+                produced - handed,
+                device_s=elapsed * (b - len(entries)) / max(1, b),
+            )
 
     def _run_greedy_batch(self, entries: list) -> None:
         """Dispatcher callback: run up to SERVER_BATCH queued greedy
@@ -1518,6 +1626,7 @@ class ServingState:
             padded = self._pad_rows(rows, width)
             lengths = jnp.asarray([len(r) for r in rows], jnp.int32)
             fn = self._program(max_new, 0.0, 0, 0.0)
+            t0 = time.perf_counter()
             with self._lock:
                 with PROFILER.phase(
                     "generate",
@@ -1531,11 +1640,14 @@ class ServingState:
                 tokens = np.asarray(out)
             for i, entry in enumerate(entries):
                 entry["tokens"] = tokens[i][:entry["max_new"]].tolist()
+            self._ledger_batch(entries, int(tokens.size), b,
+                               time.perf_counter() - t0)
             return
 
         span = width + max_new
         budgets = [e.get("budget", e["max_new"]) for e in entries]
         budgets += [1] * (b - len(entries))   # pad rows finish instantly
+        t0 = time.perf_counter()
         with self._lock:
             if len(entries) == 1:
                 # all rows replicate row 0 → the solo warm-or-cold path
@@ -1554,6 +1666,8 @@ class ServingState:
             )
         for i, entry in enumerate(entries):
             entry["tokens"] = tokens[i][:entry["max_new"]].tolist()
+        self._ledger_batch(entries, int(tokens.size), b,
+                           time.perf_counter() - t0)
 
     def _ngram_host(self, ctx: list, last: int) -> list:
         """Latest-occurrence n-gram proposal over the host-side context
@@ -1612,6 +1726,7 @@ class ServingState:
         ck = self._cached_program(("lookup_chunk", k), _build_chunk)
 
         padded = self._pad_rows([ids], width)
+        t_pf = time.perf_counter()
         with PROFILER.phase(
             "prefill", key=("prefill", span), tracer=TRACER,
         ) as pp:
@@ -1624,13 +1739,21 @@ class ServingState:
         chunk_first = PROFILER.mark_first("decode", ("lookup_chunk", k))
         chunk_s = 0.0
         chunk_n = 0
+        dev_s = time.perf_counter() - t_pf
         last = int(np.argmax(np.asarray(logits)[0]))
         emitted = [last]
         ctx = list(ids) + [last]
         rounds = drafted = accepted = 0
+        # goodput: the prefill sample is 1 produced token, each round's
+        # chunk produces k+1 more; what reaches a yield is delivered.
+        # Both sides settle in the finally (it runs on disconnect too).
+        produced = 1
+        delivered = 0
         try:
             done = self.eos_id is not None and last == self.eos_id
-            yield [] if done else [last]          # EOS itself is not emitted
+            first_out = [] if done else [last]    # EOS itself is not emitted
+            delivered += len(first_out)
+            yield first_out
             while not done and len(emitted) < max_new:
                 drafts = self._ngram_host(ctx, last)
                 t_ck = time.perf_counter()
@@ -1640,6 +1763,8 @@ class ServingState:
                 )
                 g = np.asarray(greedy).tolist()              # k+1 tokens
                 d_ck = time.perf_counter() - t_ck
+                produced += k + 1
+                dev_s += d_ck
                 if chunk_first and rounds == 0:
                     PROFILER.observe("decode", d_ck, mode="compile")
                 else:
@@ -1664,6 +1789,7 @@ class ServingState:
                 if self.eos_id is not None and self.eos_id in new:
                     new = new[:new.index(self.eos_id)]
                     done = True
+                delivered += len(new)
                 yield new
             if finish is not None:
                 finish["reason"] = "stop" if done else "length"
@@ -1683,6 +1809,9 @@ class ServingState:
             if self.ready:
                 TOKENS_GENERATED.inc(len(emitted))
                 PROMPT_TOKENS.inc(len(ids))
+                LEDGER.emitted(produced)
+                LEDGER.settle("useful", delivered, device_s=dev_s)
+                LEDGER.bubble(produced - delivered)
             if finish is not None:
                 finish["spec"] = {
                     "rounds": rounds + 1, "drafted": drafted,
@@ -1736,6 +1865,7 @@ class ServingState:
 
         greedy_default = _is_greedy(temperature, top_k, top_p)
         spec = None
+        ledger_device_s = 0.0
         if self.prompt_lookup and greedy_default:
             # draft-free speculation: tokens are exactly the greedy
             # decode at this cache span, EOS-trimmed by the loop
@@ -1761,6 +1891,7 @@ class ServingState:
                 entry["dispatched"].wait()
             with TRACER.phase("batch", quiet=True, mode="continuous"):
                 tokens = _Batcher.result(entry)
+            ledger_device_s = entry.get("_device_s") or 0.0
         elif self._batcher is not None and greedy_default:
             # greedy rows coalesce without changing output, by the
             # ragged-row identity (up to the documented cache-span
@@ -1775,12 +1906,14 @@ class ServingState:
                 entry["dispatched"].wait()
             with TRACER.phase("batch", quiet=True, mode="batched"):
                 tokens = self._batcher.result(entry)
+            ledger_device_s = entry.get("_device_s") or 0.0
         elif greedy_default and self.mesh is None:
             # solo greedy, single device: the segmented hot path —
             # warm-prefix prefill when the store holds a match, then
             # early-exit decode that stops at the REQUESTED budget (or
             # EOS) instead of scanning to the bucketed run length
             span = width + run_max_new
+            t0 = time.perf_counter()
             with self._locked_phase():
                 with TRACER.phase("batch", quiet=True, mode="solo"):
                     logits, cache = self._prefill_any(ids, width, span)
@@ -1789,6 +1922,11 @@ class ServingState:
                         cache, first, [max_new], run_max_new, 1
                     )
                     tokens = out[0].tolist()
+            ledger_device_s = time.perf_counter() - t0
+            if self.ready:
+                # solo production: every decoded cell is handed to this
+                # request — complete()'s settlement covers it all
+                LEDGER.emitted(int(out.size))
         else:
             fn = self._program(run_max_new, float(temperature), int(top_k),
                                float(top_p))
@@ -1796,6 +1934,7 @@ class ServingState:
             # part of what identifies "this compile" to the profiler
             gkey = ("generate", run_max_new, float(temperature), int(top_k),
                     float(top_p), width, 1)
+            t0 = time.perf_counter()
             with self._locked_phase():
                 with TRACER.phase("batch", quiet=True, mode="solo"):
                     with PROFILER.phase(
@@ -1809,6 +1948,10 @@ class ServingState:
                                 [len(ids)], jnp.int32),
                         ))
                     tokens = np.asarray(out)[0].tolist()
+            ledger_device_s = time.perf_counter() - t0
+            if self.ready:
+                LEDGER.emitted(len(tokens))
+        decoded = len(tokens)
         tokens = tokens[:max_new]              # bucketed run → requested budget
         if self.eos_id is not None and self.eos_id in tokens:
             tokens = tokens[:tokens.index(self.eos_id)]
@@ -1817,6 +1960,13 @@ class ServingState:
             # the lookup path already counted inside _lookup_rounds
             TOKENS_GENERATED.inc(len(tokens))
             PROMPT_TOKENS.inc(len(ids))
+            # settle the request's raw decode: what the client takes is
+            # useful, the trims (budget cap, trailing EOS) are bubble —
+            # the lookup path settles inside _lookup_rounds instead
+            LEDGER.settle_request(
+                "useful", delivered=len(tokens), decoded=decoded,
+                device_s=ledger_device_s,
+            )
         with TRACER.phase("decode", quiet=True, tokens=len(tokens)):
             text = self.decode_text(tokens)
         result = {
@@ -1908,11 +2058,21 @@ class ServingState:
         def tokens():
             if self.ready:
                 PROMPT_TOKENS.inc(len(ids))
+            t_pf = time.perf_counter()
             logits, cache = self._prefill_any(ids, width, span)
             tok = _sample(
                 logits, first_rng, float(temperature), int(top_k),
                 float(top_p),
             )
+            # goodput: each computed token is `pending` until its yield
+            # hands it over (then useful) — anything still pending at
+            # generator close settles as the terminal class (cancelled
+            # by default: a disconnect closes the generator mid-yield)
+            pending = 1
+            term_cls = "cancelled"
+            dev_s = time.perf_counter() - t_pf
+            if self.ready:
+                LEDGER.emitted(1)
             # decode attribution: the step program's first call carries
             # trace+compile and is phased on its own; the remaining steps
             # accumulate OUTSIDE the yields (consumer pacing must not
@@ -1930,6 +2090,7 @@ class ServingState:
                         return
                     if expired(deadline):
                         DEADLINE_TOTAL.labels("resident").inc()
+                        term_cls = "expired"
                         raise DeadlineExceeded(
                             "deadline expired mid-stream"
                         )
@@ -1937,14 +2098,22 @@ class ServingState:
                     if self.eos_id is not None and t == self.eos_id:
                         if finish is not None:
                             finish["reason"] = "stop"
+                        if self.ready:   # the EOS token is never handed
+                            LEDGER.bubble(pending, device_s=dev_s)
+                        pending = 0
                         return
                     if self.ready:
                         TOKENS_GENERATED.inc()
                     yield [t]
+                    if self.ready:
+                        LEDGER.settle("useful", pending, device_s=dev_s)
+                    pending = 0
+                    dev_s = 0.0
                     if i + 1 == max_new:
                         if finish is not None:
                             finish["reason"] = "length"
                         return
+                    t0 = time.perf_counter()
                     if i == 0:
                         with PROFILER.phase(
                             "decode", key=step_key, tracer=TRACER,
@@ -1954,17 +2123,22 @@ class ServingState:
                             )
                             pd.sync(tok)
                     else:
-                        t0 = time.perf_counter()
                         tok, cache = step(
                             self.params, cache, tok, step_rngs[i]
                         )
                         jax.block_until_ready(tok)
                         tail_s += time.perf_counter() - t0
                         tail_n += 1
+                    dev_s += time.perf_counter() - t0
+                    if self.ready:
+                        LEDGER.emitted(1)
+                    pending = 1
             finally:
                 if tail_n:
                     PROFILER.observe("decode", tail_s, mode="execute",
                                      calls=tail_n)
+                if pending and self.ready:
+                    LEDGER.settle(term_cls, pending, device_s=dev_s)
 
         with self._locked_phase():
             yield from self._safe_deltas(tokens())
@@ -1978,7 +2152,8 @@ class _Handler(BaseHTTPRequestHandler):
     # path-scanning client can't mint unbounded label cardinality
     _ENDPOINTS = frozenset({
         "/healthz", "/metrics", "/v1/models", "/debug/profile",
-        "/v1/completions", "/v1/chat/completions", "/drain",
+        "/debug/ledger", "/v1/completions", "/v1/chat/completions",
+        "/drain",
     })
 
     def log_message(self, fmt, *args):
@@ -2078,6 +2253,13 @@ class _Handler(BaseHTTPRequestHandler):
             # (obs/profile.py summary) — `tpu-kubernetes get profile`
             # renders this payload
             return self._json(200, PROFILER.summary())
+        if self.path == "/debug/ledger":
+            # the goodput ledger: token classes, conservation balance,
+            # slot-engine timeline, analytical roofline — what
+            # `tpu-kubernetes get goodput` renders
+            payload = LEDGER.snapshot()
+            payload["roofline"] = PROFILER.roofline()
+            return self._json(200, payload)
         if self.path.startswith("/debug/trace/"):
             # the span tree of one request/run, looked up by the id the
             # response's X-Request-Id header carried
@@ -2122,6 +2304,12 @@ class _Handler(BaseHTTPRequestHandler):
             "metrics": {
                 "tokens_generated": int(TOKENS_GENERATED.value),
                 "prompt_tokens": int(PROMPT_TOKENS.value),
+            },
+            # one-glance goodput mirror (full ledger at /debug/ledger)
+            "goodput": {
+                "ratio": LEDGER.goodput(),
+                "bubble_fraction": LEDGER.bubble_fraction(),
+                "unsettled": LEDGER.unsettled(),
             },
         }
         if st.prefix_cache is not None:
