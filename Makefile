@@ -3,7 +3,7 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast obs-check monitor-check perf-check goodput-check serve-identity-check serve-continuous-check resilience-check bench dryrun native dist dist-offline clean
+.PHONY: test test-fast obs-check monitor-check perf-check goodput-check serve-identity-check serve-continuous-check paged-check resilience-check bench dryrun native dist dist-offline clean
 
 test:
 	python -m pytest tests/ -q
@@ -79,6 +79,18 @@ serve-continuous-check:
 	  "tests/test_decode.py::test_slot_decode_identity_with_solo_decode" \
 	  "tests/test_perfbench.py::test_continuous_decode_beats_round_based_dispatch" \
 	  -q
+
+# Paged-KV gate: everything named "paged" — the pool/table primitives
+# and their solo-identity tests (test_decode.py), the paged engine's
+# identity/stall/stats/HTTP suite (test_serve_continuous.py), the
+# page-conservation chaos matrix (test_faults.py), and the 4x-slots-
+# in-the-same-bytes acceptance criterion (test_perfbench.py,
+# slow-marked so tier-1 skips it but this target runs it).
+paged-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py \
+	  tests/test_serve_continuous.py tests/test_faults.py \
+	  tests/test_perfbench.py \
+	  -q -k paged
 
 # Resilience gate: the serve-path failure-handling suites — deadlines /
 # admission / drain / watchdog units and e2e (test_resilience.py), the

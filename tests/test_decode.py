@@ -600,3 +600,220 @@ def test_slot_decode_identity_with_solo_decode(params, kv_quant):
             break
     for i in range(3):
         assert collected[i] == refs[i], f"row {i} diverged"
+
+
+# ---------------------------------------------------------------------------
+# paged KV cache primitives: page-table decode must be bitwise the dense
+# slot engine (ISSUE 8) — `make paged-check` / `make serve-identity-check`
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_paged_insert_gather_clear_roundtrip(params, kv_quant):
+    """paged_insert_row → gather_pages must reproduce the inserted row
+    bitwise (the warm-prefix bridge rests on this), untouched pages
+    stay cold, and paged_clear_pages — through a PADDED index array —
+    returns the pool to bitwise-cold for reuse."""
+    from tpu_kubernetes.models.decode import (
+        gather_pages,
+        init_paged_pool,
+        paged_clear_pages,
+        paged_insert_row,
+    )
+
+    prompt = jax.random.randint(jax.random.PRNGKey(70), (1, 16), 0,
+                                CFG.vocab_size)
+    _, row = prefill(params, prompt, CFG, max_seq=16, kv_quant=kv_quant)
+    pool0 = init_paged_pool(CFG, 8, 8, kv_quant=kv_quant)
+
+    pool = paged_insert_row(pool0, row, jnp.asarray([3, 5], jnp.int32))
+    got = gather_pages(pool, jnp.asarray([3, 5], jnp.int32))
+    for a, b in zip(
+        (got.k, got.v, got.k_scale, got.v_scale),
+        (row.k, row.v, row.k_scale, row.v_scale),
+    ):
+        if b is not None:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the insert touches ONLY its pages (page 0 is the sink, 1..8 pool)
+    for other in (0, 1, 2, 4, 6, 7, 8):
+        np.testing.assert_array_equal(
+            np.asarray(pool.k[:, other]), np.asarray(pool0.k[:, other])
+        )
+
+    # padded clear: sentinel entries (>= n_pages + 1) drop harmlessly
+    cleared = paged_clear_pages(
+        pool, jnp.asarray([3, 5, 99, 99], jnp.int32)
+    )
+    for a, b in zip(cleared, pool0):
+        if a is not None:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_paged_insert_skip_never_writes_shared_pages(params):
+    """The zero-copy warm-start contract: an insert with ``skip`` must
+    leave the skipped (shared, store-pinned) pages' slots untouched and
+    scatter the suffix pages exactly as a full insert would — this is
+    what makes copy-on-write structural rather than enforced."""
+    from tpu_kubernetes.models.decode import (
+        init_paged_pool,
+        paged_insert_row,
+    )
+
+    prompt = jax.random.randint(jax.random.PRNGKey(71), (1, 32), 0,
+                                CFG.vocab_size)
+    _, row = prefill(params, prompt, CFG, max_seq=32)
+    pool0 = init_paged_pool(CFG, 8, 8)
+
+    full = paged_insert_row(
+        pool0, row, jnp.asarray([1, 2, 3, 4], jnp.int32)
+    )
+    warm = paged_insert_row(
+        pool0, row, jnp.asarray([5, 6, 3, 4], jnp.int32), skip=16,
+    )
+    # suffix pages match the full insert bitwise...
+    for p in (3, 4):
+        np.testing.assert_array_equal(
+            np.asarray(warm.k[:, p]), np.asarray(full.k[:, p])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(warm.v[:, p]), np.asarray(full.v[:, p])
+        )
+    # ...and the skipped pages were never written
+    for p in (5, 6):
+        np.testing.assert_array_equal(
+            np.asarray(warm.k[:, p]), np.asarray(pool0.k[:, p])
+        )
+
+
+def test_paged_insert_rejects_bad_rows(params):
+    from tpu_kubernetes.models.decode import (
+        init_paged_pool,
+        paged_insert_row,
+    )
+
+    prompt = jax.random.randint(jax.random.PRNGKey(72), (1, 16), 0,
+                                CFG.vocab_size)
+    _, row = prefill(params, prompt, CFG, max_seq=16)
+    pool = init_paged_pool(CFG, 4, 8)
+    two = jnp.asarray([1, 2], jnp.int32)
+    with pytest.raises(ValueError, match="pages x page_size"):
+        paged_insert_row(pool, row, jnp.asarray([1], jnp.int32))
+    _, wide = prefill(params, jnp.tile(prompt, (2, 1)), CFG, max_seq=16)
+    with pytest.raises(ValueError, match="batch-1"):
+        paged_insert_row(pool, wide, two)
+    with pytest.raises(ValueError, match="page-aligned"):
+        paged_insert_row(pool, row, two, skip=4)
+    _, qrow = prefill(params, prompt, CFG, max_seq=16, kv_quant=True)
+    with pytest.raises(ValueError, match="kv-quant mismatch"):
+        paged_insert_row(pool, qrow, two)
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_paged_decode_identity_with_solo_decode(params, kv_quant):
+    """The tentpole identity: rows decoded through a page table
+    (decode_segment_paged over a shared pool) must emit EXACTLY the
+    tokens each row emits decoded solo — fp32 AND int8, including a row
+    admitted MID-STREAM into pages just recycled from a drained row
+    (post-clear reuse), the full slot lifecycle over one pool."""
+    from tpu_kubernetes.models.decode import (
+        decode_segment,
+        decode_segment_paged,
+        init_paged_pool,
+        init_slot_state,
+        paged_clear_pages,
+        paged_insert_row,
+    )
+
+    ps = 8
+    max_pages = CFG.max_seq // ps                  # virtual span 128 ==
+    plens = [6, 11, 9]                             # the dense engine's
+    widths = [8, 16, 16]
+    budgets = [9, 4, 6]
+    prompts = [
+        jax.random.randint(jax.random.PRNGKey(80 + i), (1, n), 0,
+                           CFG.vocab_size)
+        for i, n in enumerate(plens)
+    ]
+
+    refs = []
+    for i in range(3):
+        padded = jnp.pad(prompts[i], ((0, 0), (0, widths[i] - plens[i])))
+        logits, cache = prefill(
+            params, padded, CFG, max_seq=widths[i] + budgets[i],
+            lengths=jnp.asarray([plens[i]], jnp.int32),
+            kv_quant=kv_quant,
+        )
+        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        toks, _, _, _ = decode_segment(
+            params, cache, first, jnp.zeros((1,), bool), CFG,
+            steps=budgets[i] - 1,
+        )
+        refs.append([int(first[0])] + np.asarray(toks)[0].tolist())
+
+    rows, firsts = [], []
+    for i in range(3):
+        padded = jnp.pad(prompts[i], ((0, 0), (0, widths[i] - plens[i])))
+        logits, row = prefill(
+            params, padded, CFG, max_seq=widths[i],
+            lengths=jnp.asarray([plens[i]], jnp.int32),
+            kv_quant=kv_quant,
+        )
+        rows.append(row)
+        firsts.append(int(np.argmax(np.asarray(logits)[0])))
+
+    # two full-span page runs: row 0 owns 1..16, row 1 owns 17..32; the
+    # third request will REUSE row 1's pages after it drains and wipes
+    pool = init_paged_pool(CFG, 32, ps, kv_quant=kv_quant)
+    table = np.zeros((4, max_pages), np.int32)
+    st = init_slot_state(4)
+
+    def admit(pool, st, i, slot, pages):
+        pool = paged_insert_row(
+            pool, rows[i],
+            jnp.asarray(pages[:widths[i] // ps], jnp.int32),
+        )
+        table[slot, :len(pages)] = pages
+        st = st._replace(
+            tok=st.tok.at[slot].set(firsts[i]),
+            pos=st.pos.at[slot].set(widths[i]),
+            remaining=st.remaining.at[slot].set(budgets[i] - 1),
+            prompt_lengths=st.prompt_lengths.at[slot].set(plens[i]),
+            prompt_slots=st.prompt_slots.at[slot].set(widths[i]),
+        )
+        return pool, st
+
+    run0 = list(range(1, 17))
+    run1 = list(range(17, 33))
+    pool, st = admit(pool, st, 0, 2, run0)
+    pool, st = admit(pool, st, 1, 0, run1)
+    collected = {0: [firsts[0]], 1: [firsts[1]]}
+    slot_of = {0: 2, 1: 0}
+    admitted_third = False
+    while True:
+        old_pos = np.asarray(st.pos)
+        toks, st, pool = decode_segment_paged(
+            params, pool, jnp.asarray(table), st, CFG, steps=3,
+        )
+        new_pos = np.asarray(st.pos)
+        toks = np.asarray(toks)
+        for i, s in list(slot_of.items()):
+            emitted = int(new_pos[s] - old_pos[s])
+            collected[i].extend(toks[s][:emitted].tolist())
+        rem = np.asarray(st.remaining)
+        if not admitted_third and rem[slot_of[1]] <= 0:
+            # row 1 drained: retire its slot (table → page-0 sink),
+            # wipe its pages cold, and admit row 2 into exactly those
+            # recycled pages in a DIFFERENT slot
+            table[slot_of[1], :] = 0
+            pool = paged_clear_pages(
+                pool, jnp.asarray(run1, jnp.int32)
+            )
+            pool, st = admit(pool, st, 2, 3, run1)
+            collected[2] = [firsts[2]]
+            slot_of[2] = 3
+            admitted_third = True
+            continue
+        if admitted_third and rem.max() <= 0:
+            break
+    for i in range(3):
+        assert collected[i] == refs[i], f"paged row {i} diverged"

@@ -297,6 +297,203 @@ def test_slot_recycled_after_insert_failure(cont_state):
     _settle(lambda: SLOT_OCCUPANCY.value == 0)
 
 
+# ---------------------------------------------------------------------------
+# paged KV cache (SERVE_KV_POOL_MB): identity, pool accounting, stalls
+# ---------------------------------------------------------------------------
+
+# llama-test fp32: a 16-position page is 8 KiB, so 0.5 MB is a 64-page
+# pool; the 128-position span is max_pages=8 — room for 8 full rows
+PAGED_ENV = dict(
+    SERVE_CONTINUOUS_BATCHING="1", SERVER_BATCH="4",
+    SERVE_KV_POOL_MB="0.5", SERVE_KV_PAGE_SIZE="16",
+)
+
+
+@pytest.fixture(scope="module")
+def paged_state():
+    """The paged engine with a prefix store sharing its pool."""
+    return _state(SERVE_PREFIX_CACHE_MB="8", **PAGED_ENV)
+
+
+def _pages_conserved(state):
+    s = state._engine._pages.stats()
+    return s["free"] + s["live"] + s["pinned"] == s["total"]
+
+
+def test_paged_identity_with_solo_greedy(solo_state, paged_state):
+    """The paged engine's ragged attention through the page table must
+    be invisible: a mixed staggered batch matches solo token-for-token,
+    and every page is back on an accountable list once rows drain."""
+    refs = [
+        solo_state.complete(p, max_new_tokens=b)
+        for p, b in zip(PROMPTS, BUDGETS)
+    ]
+    outs = _fan_out(paged_state, PROMPTS, BUDGETS)
+    for out, ref in zip(outs, refs):
+        assert out["text"] == ref["text"]
+        assert out["tokens"] == ref["tokens"]
+    _settle(lambda: paged_state._engine.stats()["occupied"] == 0)
+    assert _pages_conserved(paged_state)
+
+
+def test_paged_identity_int8_kv_quant():
+    """Quantized pool: pages carry k/v int8 bytes AND their scales —
+    paged int8 rows must match solo int8 rows exactly."""
+    kv_solo = _state(SERVE_KV_QUANT="1", SERVE_EARLY_EXIT_STEPS="0")
+    kv_paged = _state(SERVE_KV_QUANT="1", **PAGED_ENV)
+    refs = [
+        kv_solo.complete(p, max_new_tokens=b)
+        for p, b in zip(PROMPTS, BUDGETS)
+    ]
+    outs = _fan_out(kv_paged, PROMPTS, BUDGETS)
+    for out, ref in zip(outs, refs):
+        assert out["text"] == ref["text"]
+    _settle(lambda: kv_paged._engine.stats()["occupied"] == 0)
+    assert _pages_conserved(kv_paged)
+
+
+def test_paged_identity_warm_prefix(solo_state, paged_state):
+    """A warm resume gathers the store's PINNED pages (zero-copy) into
+    the prefill instead of re-running the prompt — and must still match
+    the cache-free solo server token-for-token."""
+    eng = paged_state._engine
+    ref = solo_state.complete(PROMPTS[0], max_new_tokens=8)
+
+    first = paged_state.complete(PROMPTS[0], max_new_tokens=8)
+    assert first["text"] == ref["text"]
+    # the engine owns its own paged store: entries pin whole pages
+    _settle(lambda: len(eng._prefix) >= 1)
+    _settle(lambda: eng._pages.stats()["pinned"] >= 1)
+
+    again = paged_state.complete(PROMPTS[0], max_new_tokens=8)
+    assert again["text"] == ref["text"]
+
+    # warm and cold rows co-resident in one mixed paged batch
+    refs = [
+        solo_state.complete(p, max_new_tokens=b)
+        for p, b in zip(PROMPTS, BUDGETS)
+    ]
+    outs = _fan_out(paged_state, PROMPTS, BUDGETS)
+    for out, r in zip(outs, refs):
+        assert out["text"] == r["text"]
+    _settle(lambda: eng.stats()["occupied"] == 0)
+    assert _pages_conserved(paged_state)
+
+
+def test_paged_identity_mid_stream_admission(solo_state, paged_state):
+    """A row admitted while another row decodes through its page run
+    must scatter into disjoint pages — neither row perturbs the other."""
+    eng = paged_state._engine
+    ids_long = paged_state.encode(PROMPTS[0])
+    ids_late = paged_state.encode(PROMPTS[1])
+    ref_long = solo_state.complete(PROMPTS[0], max_new_tokens=16)
+    ref_late = solo_state.complete(PROMPTS[1], max_new_tokens=4)
+
+    e1 = eng.enqueue(ids_long, 16)
+    assert e1["dispatched"].wait(30)
+    slot = eng._entries.index(e1)
+    deadline = time.monotonic() + 30
+    while (eng._pos[slot] <= eng._ps[slot]
+           and e1 in eng._entries
+           and time.monotonic() < deadline):
+        time.sleep(0.001)
+    e2 = eng.enqueue(ids_late, 4)
+    assert e1["event"].wait(60) and e2["event"].wait(60)
+    assert (paged_state.decode_text(_Batcher.result(e1)[:16])
+            == ref_long["text"])
+    assert (paged_state.decode_text(_Batcher.result(e2)[:4])
+            == ref_late["text"])
+
+
+def test_paged_admission_stalls_until_pages_free(solo_state):
+    """With a pool barely larger than one full row, a second request
+    must WAIT in the queue (page stall, not failure) until the resident
+    row drains and returns its pages."""
+    from tpu_kubernetes.serve.server import PAGE_STALLS
+
+    # 8 pages x 8 KiB (the one-full-row floor): a bucket-64 admission
+    # takes 5 pages (4 prompt + 1 decode), leaving 3 free — below the
+    # 5 a SECOND bucket-64 admission requires
+    tiny = _state(SERVE_CONTINUOUS_BATCHING="1", SERVER_BATCH="4",
+                  SERVE_KV_POOL_MB=str(8 * 8192 / 2**20),
+                  SERVE_KV_PAGE_SIZE="16")
+    assert tiny._engine._pages.total == 8
+    ref_long = solo_state.complete(PROMPTS[0], max_new_tokens=16)
+    ref_late = solo_state.complete(PROMPTS[2], max_new_tokens=4)
+
+    eng = tiny._engine
+    s0 = PAGE_STALLS.value
+    e1 = eng.enqueue(tiny.encode(PROMPTS[0]), 16)
+    assert e1["dispatched"].wait(30)           # holds 5 of 8 pages
+    e2 = eng.enqueue(tiny.encode(PROMPTS[2]), 4)
+    assert e1["event"].wait(60) and e2["event"].wait(60)
+    assert (tiny.decode_text(_Batcher.result(e1)[:16])
+            == ref_long["text"])
+    assert (tiny.decode_text(_Batcher.result(e2)[:4])
+            == ref_late["text"])
+    assert PAGE_STALLS.value > s0              # e2 queued behind pages
+    _settle(lambda: eng.stats()["occupied"] == 0)
+    assert _pages_conserved(tiny)
+
+
+def test_paged_engine_stats_surface(paged_state):
+    """stats() carries the pool partition the gauge exports — and the
+    partition always sums to the pool size (leak tripwire)."""
+    _fan_out(paged_state, PROMPTS[:2], [4, 4])
+    _settle(lambda: paged_state._engine.stats()["occupied"] == 0)
+    stats = paged_state._engine.stats()
+    pages = stats["pages"]
+    assert pages["page_size"] == 16
+    assert pages["total"] == 64
+    assert (pages["free"] + pages["live"] + pages["pinned"]
+            == pages["total"])
+    assert pages["live"] == 0                  # all rows drained
+    _settle(lambda: SLOT_OCCUPANCY.value == 0)
+
+
+@pytest.fixture(scope="module")
+def paged_server():
+    srv = make_server(dict(
+        ENV, SERVER_HOST="127.0.0.1", SERVER_PORT="0",
+        SERVE_PREFIX_CACHE_MB="8", **PAGED_ENV,
+    ))
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv
+    srv.shutdown()
+
+
+def test_paged_http_metrics_healthz_and_ledger(paged_server):
+    req = {"prompt": PROMPTS[0], "max_new_tokens": 4}
+    status, body = _request(paged_server, "POST", "/v1/completions", req)
+    assert status == 200 and json.loads(body)["text"]
+
+    status, body = _request(paged_server, "GET", "/metrics")
+    text = body.decode()
+    assert status == 200
+    assert "# TYPE tpu_serve_kv_pages gauge" in text
+    assert 'tpu_serve_kv_pages{state="free"}' in text
+    assert "# TYPE tpu_serve_kv_page_stalls_total counter" in text
+    assert "# TYPE tpu_serve_kv_page_preemptions_total counter" in text
+
+    def pool_surfaced():
+        status, body = _request(paged_server, "GET", "/healthz")
+        assert status == 200
+        cb = json.loads(body)["continuous_batching"]
+        pages = cb.get("pages")
+        assert pages and pages["total"] == 64
+        return (cb["occupied"] == 0
+                and pages["free"] + pages["live"] + pages["pinned"]
+                == pages["total"])
+
+    _settle(pool_surfaced)
+
+    status, body = _request(paged_server, "GET", "/debug/ledger")
+    assert status == 200
+    kv = json.loads(body)["kv_pages"]
+    assert kv["free"] + kv["live"] + kv["pinned"] == kv["total"] == 64
+
+
 def test_token_identity_survives_segment_failure(solo_state, cont_state):
     """A mid-decode segment failure errors the resident rows out (they
     reach a terminal state, not a hang) and resets the engine cold —

@@ -894,3 +894,321 @@ def decode_segment_slots(
         step, (cache, st), None, length=steps
     )
     return toks.T, st, cache
+
+
+# ---------------------------------------------------------------------------
+# paged KV cache: a fixed page pool + per-slot page tables
+# ---------------------------------------------------------------------------
+
+
+class PagedKVCache(NamedTuple):
+    """A fixed POOL of KV pages shared by every slot: k/v are
+    (n_layers, n_pages + 1, kv_heads, page_size, head_dim) — one
+    *logical* page spans all layers and covers ``page_size`` contiguous
+    cache positions of ONE row. Physical page 0 is a reserved write
+    SINK: retired rows keep scattering into it (static shapes — the
+    paged analog of a dead dense row re-writing its own frozen slot)
+    and unallocated page-table entries point at it, so allocation never
+    happens inside a compiled program. Usable pages are 1..n_pages; a
+    host-side free list (serve/pages.py) owns which of those are live.
+
+    Unlike :class:`KVCache` there is no position metadata here — the
+    pool is pure storage. WHERE a row's positions live is the page
+    table, a (slots, max_pages) int32 array the HOST owns and passes
+    into every paged program (position p of row i lives at page
+    table[i, p // page_size], offset p % page_size); WHEN a row stops
+    is :class:`SlotState`, unchanged. Attention gathers each row's
+    pages back into a (max_pages * page_size)-wide virtual dense row
+    and runs the exact ``_attend_cache`` the dense engine runs — with
+    ``max_pages * page_size == max_seq`` the compiled attention is the
+    same shape, same reduction order, so paged greedy decode is
+    token-identical to the dense slot engine (masked slots read
+    finite garbage at weight exactly 0.0, the ragged-batch argument).
+
+    int8 variant (kv_quant): k/v hold int8 and k_scale/v_scale
+    (n_layers, n_pages + 1, kv_heads, page_size) hold the symmetric
+    per-position f32 dequant scales, exactly the dense layout paged."""
+
+    k: jax.Array
+    v: jax.Array
+    k_scale: jax.Array | None = None
+    v_scale: jax.Array | None = None
+
+
+def page_bytes(cfg: ModelConfig, page_size: int,
+               kv_quant: bool = False) -> int:
+    """Device bytes of ONE logical page (all layers, k+v, scales
+    included) — the host-side pool-sizing unit behind
+    ``SERVE_KV_POOL_MB`` (pool_bytes // page_bytes = usable pages)."""
+    per_pos = cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
+    if kv_quant:
+        scale = cfg.n_layers * cfg.n_kv_heads * 4       # f32 per position
+        return page_size * 2 * (per_pos + scale)        # int8 k/v
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    return page_size * 2 * per_pos * itemsize
+
+
+def init_paged_pool(
+    cfg: ModelConfig, num_pages: int, page_size: int,
+    kv_quant: bool = False,
+) -> PagedKVCache:
+    """Allocate a cold pool of ``num_pages`` usable pages (+ the page-0
+    sink). Cold pages are bitwise what :func:`init_cache` rows are
+    (zeros; scale 1.0), and the free path wipes pages back to this, so
+    a recycled page is indistinguishable from a fresh pool's."""
+    if num_pages < 1:
+        raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    shape = (cfg.n_layers, num_pages + 1, cfg.n_kv_heads, page_size,
+             cfg.head_dim)
+    if kv_quant:
+        return PagedKVCache(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.ones(shape[:-1], jnp.float32),
+            v_scale=jnp.ones(shape[:-1], jnp.float32),
+        )
+    return PagedKVCache(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+    )
+
+
+def _to_pages(a: jax.Array, skip_pages: int, ps: int) -> jax.Array:
+    """A batch-1 dense row segment → page-major: (L, 1, kv, n*ps[, hd])
+    → (L, n - skip_pages, kv, ps[, hd]), dropping the first
+    ``skip_pages`` pages (positions already resident in the pool)."""
+    L, _, kv = a.shape[:3]
+    n = a.shape[3] // ps
+    a = a[:, 0]                                     # (L, kv, n*ps[, hd])
+    a = a.reshape((L, kv, n, ps) + a.shape[3:])
+    if a.ndim == 5:
+        a = a.transpose(0, 2, 1, 3, 4)
+    else:
+        a = a.transpose(0, 2, 1, 3)
+    return a[:, skip_pages:]
+
+
+def paged_insert_row(
+    pool: PagedKVCache, row: KVCache, pages: jax.Array, skip: int = 0,
+) -> PagedKVCache:
+    """The paged admission primitive (:func:`cache_insert_row`'s
+    analog): scatter a freshly prefilled batch-1 row cache of width
+    ``n * page_size`` into pool pages ``pages`` ((n,) int32, page i
+    receiving positions [i*page_size, (i+1)*page_size)). ``skip``
+    (STATIC, page-aligned) marks a warm-prefix admission: the first
+    ``skip`` positions already live in shared pinned pages — their
+    table entries point at the store's pages and nothing is written,
+    which is what makes a warm hit zero-copy (the dense path
+    byte-copies the prefix through the resume base instead). ``pages``
+    may be traced: jit once per (width, skip) pair, donate the pool."""
+    ps = pool.k.shape[3]
+    s_row = row.k.shape[3]
+    n = pages.shape[0]
+    if row.k.shape[1] != 1:
+        raise ValueError(f"row cache must be batch-1, got {row.k.shape[1]}")
+    if s_row != n * ps:
+        raise ValueError(
+            f"row width {s_row} != {n} pages x page_size {ps}"
+        )
+    if skip % ps or not 0 <= skip < s_row:
+        raise ValueError(
+            f"skip {skip} must be page-aligned in [0, {s_row})"
+        )
+    if (pool.k_scale is None) != (row.k_scale is None):
+        raise ValueError(
+            "pool/row kv-quant mismatch (one has int8 scales)"
+        )
+    sp = skip // ps
+    dst = pages[sp:]
+
+    def scatter(pool_a, row_a):
+        if pool_a is None:
+            return None
+        src = _to_pages(row_a, sp, ps).astype(pool_a.dtype)
+        return pool_a.at[:, dst].set(src)
+
+    return PagedKVCache(
+        k=scatter(pool.k, row.k),
+        v=scatter(pool.v, row.v),
+        k_scale=scatter(pool.k_scale, row.k_scale),
+        v_scale=scatter(pool.v_scale, row.v_scale),
+    )
+
+
+def paged_clear_pages(pool: PagedKVCache, pages: jax.Array) -> PagedKVCache:
+    """Wipe freed pages back to init values (zeros; scale 1.0) — the
+    paged :func:`cache_clear_row`. ``pages`` is (n,) int32 and may be
+    PADDED with any out-of-range sentinel (>= n_pages + 1): padded
+    entries are dropped by the scatter, so the host jits ONE program at
+    a fixed n and clears any smaller set through it."""
+    n = pages.shape[0]
+
+    def wipe(pool_a, fill):
+        if pool_a is None:
+            return None
+        shape = (pool_a.shape[0], n) + pool_a.shape[2:]
+        return pool_a.at[:, pages].set(
+            jnp.full(shape, fill, pool_a.dtype), mode="drop",
+        )
+
+    return PagedKVCache(
+        k=wipe(pool.k, 0),
+        v=wipe(pool.v, 0),
+        k_scale=wipe(pool.k_scale, 1.0),
+        v_scale=wipe(pool.v_scale, 1.0),
+    )
+
+
+def gather_pages(pool: PagedKVCache, pages: jax.Array) -> KVCache:
+    """Pages → a contiguous batch-1 dense row cache of width
+    ``n * page_size`` (uniform: every slot < length is real). The warm-
+    prefix bridge: the engine gathers a stored prefix's pinned pages
+    into the resume base :func:`prefill_resume` consumes — the bytes
+    are exactly what :func:`paged_insert_row` scattered, so the resume
+    computation matches the dense byte-copy path bitwise."""
+    ps = pool.k.shape[3]
+    n = pages.shape[0]
+
+    def dense(pool_a):
+        if pool_a is None:
+            return None
+        a = pool_a[:, pages]                 # (L, n, kv, ps[, hd])
+        if a.ndim == 5:
+            a = a.transpose(0, 2, 1, 3, 4)
+        else:
+            a = a.transpose(0, 2, 1, 3)
+        a = a.reshape(a.shape[:2] + (n * ps,) + a.shape[4:])
+        return a[:, None]                    # (L, 1, kv, n*ps[, hd])
+
+    return KVCache(
+        k=dense(pool.k), v=dense(pool.v),
+        length=jnp.asarray(n * ps, jnp.int32),
+        k_scale=dense(pool.k_scale), v_scale=dense(pool.v_scale),
+    )
+
+
+def decode_step_paged(
+    params: dict, pool: PagedKVCache, table: jax.Array, st: SlotState,
+    cfg: ModelConfig,
+) -> tuple[jax.Array, PagedKVCache]:
+    """:func:`decode_step_slots` through a page table: every row writes
+    this step's K/V at (table[row, pos // ps], pos % ps) and attends
+    its pages gathered back into a (max_pages * ps)-wide virtual dense
+    row — same masks, same ragged metadata, same einsums, so with
+    ``max_pages * ps == max_seq`` the logits are bitwise the dense
+    engine's. The host guarantees every LIVE row's table covers slot
+    ``pos`` before calling (pre-segment top-up — no in-program
+    allocation); retired rows write the page-0 sink, whose garbage no
+    live row's gather can weight above exactly 0.0. → (logits
+    (b, vocab) f32, pool with every row's position written)."""
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    b, mp = table.shape
+    ps = pool.k.shape[3]
+    h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    positions = (
+        st.prompt_lengths + (st.pos - st.prompt_slots)
+    )[:, None]                                               # (b, 1)
+    limits = (st.pos + 1)[:, None]                           # (b, 1)
+    page = jnp.take_along_axis(table, (st.pos // ps)[:, None], axis=1)
+    off = (st.pos % ps)[:, None]                             # (b, 1)
+    kv_idx = jnp.arange(kv)[None, :]
+    x = params["embed"][st.tok][:, None, :]                  # (b, 1, d)
+
+    def virtual(pool_l):
+        """One layer's pages → the (b, kv, mp*ps[, hd]) virtual dense
+        cache every row's attention reads."""
+        a = pool_l[table]                    # (b, mp, kv, ps[, hd])
+        if a.ndim == 5:
+            a = a.transpose(0, 2, 1, 3, 4)
+        else:
+            a = a.transpose(0, 2, 1, 3)
+        return a.reshape(a.shape[:2] + (mp * ps,) + a.shape[4:])
+
+    def block(carry, xs):
+        x, (k_all, v_all, ks_all, vs_all) = carry
+        layer, li = xs
+        y = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = (y @ _w(layer["wq"], cfg.dtype)).reshape(b, 1, h, hd)
+        q = q.transpose(0, 2, 1, 3)
+        k = (y @ _w(layer["wk"], cfg.dtype)).reshape(b, 1, kv, hd)
+        k = k.transpose(0, 2, 1, 3)
+        v = (y @ _w(layer["wv"], cfg.dtype)).reshape(b, 1, kv, hd)
+        v = v.transpose(0, 2, 1, 3)
+        q = apply_rope(q, cos, sin, positions=positions)
+        k = apply_rope(k, cos, sin, positions=positions)
+        k1, v1 = k[:, :, 0, :], v[:, :, 0, :]                # (b, kv, hd)
+        if ks_all is not None:
+            k1, k_sc = _quantize_kv(k1)
+            v1, v_sc = _quantize_kv(v1)
+            ks_all = ks_all.at[li, page, kv_idx, off].set(k_sc)
+            vs_all = vs_all.at[li, page, kv_idx, off].set(v_sc)
+        k1 = k1.astype(k_all.dtype)
+        v1 = v1.astype(v_all.dtype)
+        # live rows own their pages exclusively, so scatter indices
+        # collide only on the sink (dead rows) — never-read, any winner
+        k_all = k_all.at[li, page, kv_idx, off].set(k1)
+        v_all = v_all.at[li, page, kv_idx, off].set(v1)
+        k_l = jax.lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)
+        v_l = jax.lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)
+        k_scale = v_scale = None
+        if ks_all is not None:
+            k_scale = virtual(jax.lax.dynamic_index_in_dim(
+                ks_all, li, 0, keepdims=False))
+            v_scale = virtual(jax.lax.dynamic_index_in_dim(
+                vs_all, li, 0, keepdims=False))
+        attn = _attend_cache(cfg, q, virtual(k_l), virtual(v_l), limits,
+                             st.prompt_lengths, st.prompt_slots,
+                             k_scale=k_scale, v_scale=v_scale)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, h * hd)
+        x = x + attn @ _w(layer["wo"], cfg.dtype)
+        return (_mlp(cfg, x, layer), (k_all, v_all, ks_all, vs_all)), None
+
+    n_layers = pool.k.shape[0]
+    (x, (k_new, v_new, ks_new, vs_new)), _ = jax.lax.scan(
+        block,
+        (x, (pool.k, pool.v, pool.k_scale, pool.v_scale)),
+        (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
+    )
+    x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
+    logits = (x @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
+    return logits, PagedKVCache(
+        k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new,
+    )
+
+
+def decode_segment_paged(
+    params: dict, pool: PagedKVCache, table: jax.Array, st: SlotState,
+    cfg: ModelConfig, steps: int, *, eos_id: int | None = None,
+    pad_id: int = 0,
+) -> tuple[jax.Array, SlotState, PagedKVCache]:
+    """``steps`` greedy :func:`decode_step_paged` steps — the paged
+    :func:`decode_segment_slots`, with IDENTICAL emission logic (live
+    rows emit and advance, dead rows emit ``pad_id`` and freeze, EOS
+    zeroes the budget), so the two engines are token-identical step for
+    step. The table is constant across the segment: the host tops every
+    live row's table up to cover ``pos + min(steps, remaining)`` before
+    calling (and preempts a row when the pool cannot). → (emitted
+    (batch, steps) int32, state, pool)."""
+
+    def step(carry, _):
+        pool, st = carry
+        live = st.remaining > 0
+        logits, pool = decode_step_paged(params, pool, table, st, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        emitted = jnp.where(live, nxt, pad_id)
+        rem = jnp.where(live, st.remaining - 1, 0)
+        if eos_id is not None:
+            rem = jnp.where(live & (nxt == eos_id), 0, rem)
+        st = st._replace(
+            tok=jnp.where(live, nxt, st.tok),
+            pos=jnp.where(live, st.pos + 1, st.pos),
+            remaining=rem,
+        )
+        return (pool, st), emitted
+
+    (pool, st), toks = jax.lax.scan(
+        step, (pool, st), None, length=steps
+    )
+    return toks.T, st, pool

@@ -46,6 +46,7 @@ SITES = frozenset({
     "serve.slot_insert",    # _ContinuousEngine._insert (cache graft)
     "serve.segment",        # _ContinuousEngine._run_segment (decode step)
     "serve.prefix_insert",  # prefix KV-cache store insert (best-effort)
+    "serve.page_alloc",     # PagePool.allocate (paged admission/top-up)
     "fleet.scrape",         # FleetAggregator per-target fetch
     "shell.terraform",      # TerraformExecutor subprocess run
 })

@@ -66,9 +66,11 @@ SERVE_PREFIX_CACHE_MB (bounded-LRU prefix KV-cache reuse),
 SERVE_EARLY_EXIT_STEPS (early-exit decode liveness interval) and
 SERVE_CONTINUOUS_BATCHING (persistent slot-engine decode: requests are
 admitted into the running batch between segments, SERVER_BATCH doubling
-as the slot count) — all documented there; the batch job runs one fused
-program per batch, so per-request caching/early-exit/slot scheduling
-does not apply here.
+as the slot count) and SERVE_KV_POOL_MB/SERVE_KV_PAGE_SIZE (paged KV
+cache for the slot engine: one shared page pool, admission gated on
+free pages, warm prefixes pinned zero-copy) — all documented there; the
+batch job runs one fused program per batch, so per-request
+caching/early-exit/slot/page scheduling does not apply here.
 
 The reference provisioner has no inference plane (SURVEY §0); this
 completes the in-tree stack's serving story end to end (provision →

@@ -1,0 +1,139 @@
+"""Host-side KV page accounting for the paged slot engine
+(``SERVE_KV_POOL_MB`` > 0): the free list, per-page reference counts,
+and the prefix store's pins over the device pool models/decode.py's
+:class:`PagedKVCache` provides.
+
+Allocation is HOST-AUTHORITATIVE by design: compiled programs never
+touch the free list (static shapes — the engine tops every live row's
+page table up *before* each segment), so this module stays jax-free and
+single-threaded-simple (the scheduler thread owns it; other threads
+only read ``stats()``).
+
+Page states are disjoint and conserved — every usable page is exactly
+one of:
+
+* **free**   — on the free list, wiped bitwise-cold on the device;
+* **live**   — referenced by at least one resident slot's table and
+  not owned by the prefix store;
+* **pinned** — owned by the prefix store (a shared read-only prompt
+  prefix), whether or not slots currently also reference it.
+
+``free + live + pinned == total`` is the no-leak invariant the chaos
+matrix asserts (tests/test_faults.py); :meth:`stats` recomputes the
+partition from the ground truth every call, so a page dropped on the
+floor breaks the sum instead of hiding. Physical page 0 — the
+compiled-program write sink for retired rows — is outside the pool and
+outside the arithmetic.
+
+A page is handed back to the free list only when its refcount is zero
+AND the store does not own it: a slot retiring decrements its pages
+(shared prefix pages survive for the next warm hit), a store eviction
+unpins (resident readers keep the page alive until they retire).
+``allocate`` is a registered chaos site (``serve.page_alloc``) so fault
+injection exercises the admission/preemption paths that consume it.
+"""
+
+from __future__ import annotations
+
+from tpu_kubernetes.obs.faults import FAULTS
+
+
+class PagePool:
+    """Free list + refcounts for ``total`` usable device pages,
+    numbered 1..total (page 0 is the device-side write sink and never
+    leaves this constructor). FIFO reuse keeps recycling pressure even
+    across the pool, which also makes leak tests deterministic."""
+
+    def __init__(self, total: int):
+        if total < 1:
+            raise ValueError(f"page pool needs >= 1 page, got {total}")
+        self.total = int(total)
+        self._free: list[int] = list(range(1, self.total + 1))
+        self._refs: dict[int, int] = {}
+        self._pinned: set[int] = set()
+        self.stalls = 0          # allocation requests the pool rejected
+
+    # -- slot lifecycle -----------------------------------------------------
+
+    def allocate(self, n: int) -> list[int] | None:
+        """Take ``n`` pages off the free list at refcount 1, or None
+        when the pool cannot satisfy the request (the caller stalls
+        admission or preempts — partial grants would leak on the error
+        path). Fires the ``serve.page_alloc`` chaos site."""
+        FAULTS.fire("serve.page_alloc")
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} pages")
+        if n > len(self._free):
+            self.stalls += 1
+            return None
+        got, self._free = self._free[:n], self._free[n:]
+        for p in got:
+            self._refs[p] = 1
+        return got
+
+    def ref(self, pages: list[int]) -> None:
+        """A slot starts sharing already-resident pages (a warm-prefix
+        hit referencing the store's pinned pages)."""
+        for p in pages:
+            self._refs[p] = self._refs.get(p, 0) + 1
+
+    def release(self, pages: list[int]) -> list[int]:
+        """Drop one reference per page; returns the pages that became
+        FREE (refcount 0, not store-owned) — the caller must wipe
+        exactly these on the device before reuse."""
+        freed = []
+        for p in pages:
+            left = self._refs.get(p, 0) - 1
+            if left < 0:
+                raise RuntimeError(f"page {p} released below zero refs")
+            if left:
+                self._refs[p] = left
+                continue
+            self._refs.pop(p, None)
+            if p not in self._pinned:
+                self._free.append(p)
+                freed.append(p)
+        return freed
+
+    # -- prefix-store lifecycle ---------------------------------------------
+
+    def pin(self, pages: list[int]) -> None:
+        """The prefix store takes ownership of resident pages (they
+        must currently be referenced — pinning free pages would
+        resurrect wiped bytes)."""
+        for p in pages:
+            if p not in self._refs and p not in self._pinned:
+                raise RuntimeError(f"cannot pin non-resident page {p}")
+            self._pinned.add(p)
+
+    def unpin(self, pages: list[int]) -> list[int]:
+        """Store eviction: release ownership; returns pages now free
+        (no slot still reads them) for the caller to wipe."""
+        freed = []
+        for p in pages:
+            self._pinned.discard(p)
+            if self._refs.get(p, 0) == 0:
+                self._free.append(p)
+                freed.append(p)
+        return freed
+
+    # -- observability ------------------------------------------------------
+
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def stats(self) -> dict:
+        """The disjoint free/live/pinned partition (the /healthz and
+        gauge feed). Recomputed from the ground truth so
+        ``free + live + pinned == total`` failing IS a leak, not a
+        bookkeeping echo."""
+        free = len(self._free)
+        pinned = len(self._pinned)
+        live = len([p for p in self._refs if p not in self._pinned])
+        return {
+            "total": self.total,
+            "free": free,
+            "live": live,
+            "pinned": pinned,
+            "stalls": self.stalls,
+        }

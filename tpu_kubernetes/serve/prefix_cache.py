@@ -57,6 +57,25 @@ class PrefixEntry:
         self.nbytes = _arrays_nbytes(self.arrays)
 
 
+@dataclass
+class PagedPrefixEntry:
+    """One cached prefix under the PAGED engine: instead of byte-copied
+    arrays, ``pages`` are the device pool page ids holding the prefix's
+    K/V (whole pages only — ``len(ids)`` is a page_size multiple). The
+    store OWNS these pages (PagePool.pin): a warm hit pins them
+    read-only into the new slot's table zero-copy, and copy-on-write is
+    structural — appends land past the prompt width in fresh pages, so
+    a shared page is never written after insert. ``nbytes`` is the
+    device bytes the pages occupy (page_bytes x len(pages)), passed in
+    because this module never sees the device arrays. Eviction must
+    reach the pool: the cache's ``on_evict`` callback is how the engine
+    unpins (and wipes) a dropped entry's pages."""
+
+    ids: tuple[int, ...]
+    pages: tuple[int, ...]
+    nbytes: int
+
+
 class PrefixCache:
     """Longest-prefix-match LRU over :class:`PrefixEntry`, capped at
     ``max_bytes``. ``sig`` records the model/config signature the
@@ -66,13 +85,20 @@ class PrefixCache:
     the server points it at the prefix-cache bytes gauge."""
 
     def __init__(self, max_bytes: int, sig: tuple = (),
-                 on_bytes: Callable[[int], None] | None = None):
+                 on_bytes: Callable[[int], None] | None = None,
+                 on_evict: Callable[[Any], None] | None = None):
         if max_bytes <= 0:
             raise ValueError(f"max_bytes must be > 0, got {max_bytes}")
         self.max_bytes = int(max_bytes)
         self.sig = tuple(sig)
         self._on_bytes = on_bytes
-        self._entries: OrderedDict[tuple, PrefixEntry] = OrderedDict()
+        # on_evict(entry) fires for every entry the store DROPS — LRU
+        # eviction and extension replacement both — after the lock is
+        # released (the paged engine's handler takes its own locks to
+        # unpin + wipe the entry's pool pages; calling back under ours
+        # would order the two locks both ways)
+        self._on_evict = on_evict
+        self._entries: OrderedDict[tuple, Any] = OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
 
@@ -82,10 +108,19 @@ class PrefixCache:
         if self._on_bytes is not None:
             self._on_bytes(self._bytes)
 
-    def _evict_to_cap(self) -> None:
+    def _evict_to_cap(self) -> list:
+        dropped = []
         while self._bytes > self.max_bytes and self._entries:
             _, old = self._entries.popitem(last=False)   # least recent
             self._bytes -= old.nbytes
+            dropped.append(old)
+        return dropped
+
+    def _notify_evicted(self, dropped: list) -> None:
+        """Caller must NOT hold the lock (see on_evict above)."""
+        if self._on_evict is not None:
+            for e in dropped:
+                self._on_evict(e)
 
     # -- API ---------------------------------------------------------------
 
@@ -111,12 +146,26 @@ class PrefixCache:
         or the segment alone exceeds the cap. Inserting an EXTENSION of
         a stored prefix replaces the shorter entry; eviction then drops
         least-recently-used entries until the total fits the cap."""
-        key = tuple(ids)
+        return self._insert_entry(PrefixEntry(ids=tuple(ids),
+                                              arrays=arrays))
+
+    def insert_paged(self, ids: list[int], pages: list[int],
+                     nbytes: int) -> bool:
+        """Store a PAGED prefix (device pool page ids, see
+        :class:`PagedPrefixEntry`). Same subsumption/eviction contract
+        as :meth:`insert`; the caller pins the pages only on True (a
+        skipped insert must not strand a pin)."""
+        return self._insert_entry(PagedPrefixEntry(
+            ids=tuple(ids), pages=tuple(pages), nbytes=int(nbytes),
+        ))
+
+    def _insert_entry(self, entry: Any) -> bool:
+        key = entry.ids
         if not key:
             return False
-        entry = PrefixEntry(ids=key, arrays=arrays)
         if entry.nbytes > self.max_bytes:
             return False
+        dropped: list = []
         with self._lock:
             for have in list(self._entries.values()):
                 q = _common_prefix_len(have.ids, key)
@@ -130,11 +179,28 @@ class PrefixCache:
                     # extension: the new segment subsumes the stored one
                     del self._entries[have.ids]
                     self._bytes -= have.nbytes
+                    dropped.append(have)
             self._entries[key] = entry
             self._bytes += entry.nbytes
-            self._evict_to_cap()
+            dropped += self._evict_to_cap()
             self._notify()
-            return True
+        self._notify_evicted(dropped)
+        return True
+
+    def clear(self, notify: bool = False) -> int:
+        """Drop every entry; returns how many. The paged engine's
+        cold-reset path: a reinitialized page pool invalidates stored
+        page ids wholesale, so the default drops WITHOUT on_evict (the
+        pages no longer exist to unpin); ``notify=True`` routes the
+        drops through on_evict for an orderly teardown instead."""
+        with self._lock:
+            dropped = list(self._entries.values())
+            self._entries.clear()
+            self._bytes = 0
+            self._notify()
+        if notify:
+            self._notify_evicted(dropped)
+        return len(dropped)
 
     @property
     def bytes(self) -> int:

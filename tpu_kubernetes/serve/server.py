@@ -243,6 +243,27 @@ SLOTS_RECYCLED = REGISTRY.counter(
     "continuous batching: finished rows drained and their slots freed "
     "for the next queued request",
 )
+KV_PAGES = REGISTRY.gauge(
+    "tpu_serve_kv_pages",
+    "paged KV pool pages by state (free = wiped, on the free list; "
+    "live = referenced by a resident slot; pinned = owned by the "
+    "prefix store) — free+live+pinned always equals the pool size, a "
+    "drifting sum is a page leak",
+    labelnames=("state",),
+)
+PAGE_STALLS = REGISTRY.counter(
+    "tpu_serve_kv_page_stalls_total",
+    "paged engine: admissions or segment top-ups blocked on an empty "
+    "free list (the paged analog of slot exhaustion — sustained stalls "
+    "mean grow SERVE_KV_POOL_MB; each stall feeds the ledger's bubble "
+    "fraction an explanation)",
+)
+PAGE_PREEMPTIONS = REGISTRY.counter(
+    "tpu_serve_kv_page_preemptions_total",
+    "paged engine: resident rows preempted (pages reclaimed, entry "
+    "requeued for deterministic greedy recompute) to top up an older "
+    "row's table",
+)
 # device-synced phase attribution (obs/profile.py): prefill / decode /
 # fused-generate device seconds split by mode — "compile" is a program's
 # first call (jit trace + XLA compile ride on it), "execute" is steady
@@ -481,7 +502,8 @@ class _ContinuousEngine:
     released between, so solo/streaming/sampled requests interleave
     with a busy engine."""
 
-    def __init__(self, state: "ServingState", slots: int, seg_steps: int):
+    def __init__(self, state: "ServingState", slots: int, seg_steps: int,
+                 page_size: int = 16, pool_mb: float = 0.0):
         import numpy as np
 
         from tpu_kubernetes.models.decode import init_cache
@@ -509,9 +531,77 @@ class _ContinuousEngine:
         # segment record (scheduler-thread-only, like the slot arrays)
         self._last_admitted = 0
         self._last_reaped = 0
-        self._cache = init_cache(
-            state.cfg, slots, self.span, kv_quant=state.kv_quant
-        )
+        # -- paged KV mode (SERVE_KV_POOL_MB > 0) -----------------------
+        # HBM is a fixed page pool instead of a dense (slots, max_seq)
+        # block: concurrency is bounded by LIVE tokens, not worst-case
+        # context. The page table + free list are host-owned (pages.py);
+        # compiled programs only ever read the table, so every segment
+        # is preceded by a host-side top-up and admission gates on the
+        # free-page count instead of the slot count.
+        self.paged = pool_mb > 0
+        self._cache = None
+        self._prefix = None
+        if self.paged:
+            from tpu_kubernetes.models.decode import (
+                init_paged_pool,
+                page_bytes,
+            )
+            from tpu_kubernetes.serve.pages import PagePool
+
+            ps = int(page_size)
+            if ps < 1 or (ps & (ps - 1)) or MIN_PREFIX_TOKENS % ps:
+                raise ValueError(
+                    f"SERVE_KV_PAGE_SIZE must be a power of two "
+                    f"dividing {MIN_PREFIX_TOKENS}, got {ps} (width "
+                    "buckets and reused-prefix lengths must be page-"
+                    "aligned)"
+                )
+            self.page_size = ps
+            # every row's table covers a full span: the gathered
+            # virtual cache is max_seq wide, the SAME attention shape
+            # (and float reduction order) as the dense engine — the
+            # token-identity bar
+            self.max_pages = self.span // ps
+            if self.span % ps:
+                raise ValueError(
+                    f"SERVE_KV_PAGE_SIZE {ps} must divide max_seq "
+                    f"{self.span}"
+                )
+            self._page_nbytes = page_bytes(state.cfg, ps, state.kv_quant)
+            num_pages = int(pool_mb * 2 ** 20) // self._page_nbytes
+            if num_pages < self.max_pages:
+                raise ValueError(
+                    f"SERVE_KV_POOL_MB={pool_mb} holds {num_pages} "
+                    f"pages of {ps} positions ({self._page_nbytes} B "
+                    f"each); one full-span row needs {self.max_pages}"
+                )
+            self._pages = PagePool(num_pages)
+            self._pool = init_paged_pool(
+                state.cfg, num_pages, ps, kv_quant=state.kv_quant
+            )
+            self._table = np.zeros((slots, self.max_pages), np.int32)
+            self._slot_pages: list[list[int]] = [[] for _ in range(slots)]
+            # admission order, for youngest-first preemption
+            self._admit_seq = np.zeros(slots, np.int64)
+            self._seq = 0
+            if state.prefix_cache is not None:
+                from tpu_kubernetes.serve.prefix_cache import PrefixCache
+
+                # the engine's OWN store holds page ids over THIS pool
+                # (zero-copy warm starts); the byte store keeps serving
+                # the solo/streaming paths untouched. No on_bytes: the
+                # solo store owns that gauge, pinned pages show up in
+                # tpu_serve_kv_pages{state="pinned"} instead
+                self._prefix = PrefixCache(
+                    state.prefix_cache.max_bytes,
+                    sig=state.prefix_cache.sig,
+                    on_evict=self._on_prefix_evict,
+                )
+            self._update_page_gauge()
+        else:
+            self._cache = init_cache(
+                state.cfg, slots, self.span, kv_quant=state.kv_quant
+            )
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -546,7 +636,7 @@ class _ContinuousEngine:
         ride /metrics)."""
         with self._cond:
             queued = len(self._queue)
-        return {
+        out = {
             "slots": self.slots,
             "occupied": sum(e is not None for e in self._entries),
             "queued": queued,
@@ -554,6 +644,14 @@ class _ContinuousEngine:
             "recycled": self.recycled,
             "restarts": self.restarts,
         }
+        if self.paged:
+            # the free/live/pinned partition (sums to total — a drift
+            # IS a page leak) rides /healthz via continuous_batching
+            out["pages"] = dict(self._pages.stats(),
+                                page_size=self.page_size)
+            if self._prefix is not None:
+                out["pages"]["prefix_entries"] = len(self._prefix)
+        return out
 
     # -- scheduler thread ---------------------------------------------------
 
@@ -626,10 +724,35 @@ class _ContinuousEngine:
             )
             if free is None:
                 return
+            stalled = False
             with self._cond:
                 if not self._queue:
                     return
-                entry = self._queue.pop(0)
+                entry = self._queue[0]
+                if self.paged:
+                    # paged admission gates on FREE PAGES, not free
+                    # slots: the head entry waits until resident rows
+                    # drain enough pages for its prompt + first decode
+                    # page (conservative — a warm hit may need fewer)
+                    width = _bucket(len(entry["ids"]))
+                    required = min(
+                        width // self.page_size + 1, self.max_pages,
+                    )
+                    stalled = self._pages.free_count() < required
+                if not stalled:
+                    self._queue.pop(0)
+            if stalled:
+                PAGE_STALLS.inc()
+                if self._prefix is not None and len(self._prefix):
+                    # reclaim the store's pinned pages before making
+                    # the entry wait on live traffic (outside _cond —
+                    # the eviction handler wipes device pages)
+                    self._prefix.clear(notify=True)
+                    self._update_page_gauge()
+                    continue
+                # resident rows hold the pages: the next segments drain
+                # them; admission retries every scheduler pass
+                return
             if expired(entry.get("deadline")):
                 DEADLINE_TOTAL.labels("queued").inc()
                 entry["error"] = DeadlineExceeded(
@@ -666,30 +789,36 @@ class _ContinuousEngine:
         width = _bucket(len(ids))
         t0 = time.perf_counter()
         with st._lock:
-            # per-row width bucket; span == width (zero generation
-            # slots — decode happens in the engine cache, not the row
-            # cache), so prefill programs are shared with solo serving
-            # and the prefix store serves warm starts into slots too
-            logits, row = st._prefill_any(ids, width, width)
-            first = int(np.argmax(np.asarray(logits)[0]))
-            # production: the prefill's sampled token exists NOW — if
-            # the graft below fails, _admit settles it shed-spent via
-            # the _decoded mark (a pre-prefill fault produced nothing)
-            if st.ready:
-                LEDGER.emitted(1)
-            entry["_decoded"] = 1
-            if budget <= 1 or (st.eos_id is not None
-                               and first == st.eos_id):
-                # one-token budget or instant EOS: done without a slot
-                entry["tokens"] = [first]
+            if self.paged:
+                first = self._admit_paged(entry, slot, ids, budget,
+                                          width)
             else:
-                ins = st._cached_program(
-                    ("slot_insert",),
-                    lambda: jax.jit(
-                        cache_insert_row, donate_argnums=(0,)
-                    ),
-                )
-                self._cache = ins(self._cache, row, slot)
+                # per-row width bucket; span == width (zero generation
+                # slots — decode happens in the engine cache, not the
+                # row cache), so prefill programs are shared with solo
+                # serving and the prefix store serves warm starts into
+                # slots too
+                logits, row = st._prefill_any(ids, width, width)
+                first = int(np.argmax(np.asarray(logits)[0]))
+                # production: the prefill's sampled token exists NOW —
+                # if the graft below fails, _admit settles it shed-spent
+                # via the _decoded mark (a pre-prefill fault produced
+                # nothing)
+                if st.ready:
+                    LEDGER.emitted(1)
+                entry["_decoded"] = 1
+                if budget <= 1 or (st.eos_id is not None
+                                   and first == st.eos_id):
+                    # one-token budget or instant EOS: done, no slot
+                    entry["tokens"] = [first]
+                else:
+                    ins = st._cached_program(
+                        ("slot_insert",),
+                        lambda: jax.jit(
+                            cache_insert_row, donate_argnums=(0,)
+                        ),
+                    )
+                    self._cache = ins(self._cache, row, slot)
         entry["_device_s"] = time.perf_counter() - t0
         wait = time.monotonic() - entry["t_enq"]
         ADMISSION_WAIT.observe(wait)
@@ -706,8 +835,289 @@ class _ContinuousEngine:
         self._pl[slot] = len(ids)
         self._ps[slot] = width
         self._last_admitted += 1
+        if self.paged:
+            # admission order feeds youngest-first preemption; the
+            # store insert runs OUTSIDE st._lock — an eviction it
+            # triggers wipes device pages, which takes that lock
+            self._admit_seq[slot] = self._seq
+            self._seq += 1
+            self._prefix_store_paged(ids, slot)
+            self._update_page_gauge()
         entry["dispatched"].set()
         SLOT_OCCUPANCY.set(sum(e is not None for e in self._entries))
+
+    # -- paged-mode internals (scheduler thread only) -----------------------
+
+    def _admit_paged(self, entry: dict, slot: int, ids: list,
+                     budget: int, width: int) -> int:
+        """Paged admission (caller holds st._lock): warm-or-cold
+        prefill, then allocate the prompt's pages — a warm hit pins the
+        store's shared pages into the table by REFERENCE, zero-copy —
+        and scatter only the freshly computed suffix. Allocation is
+        all-or-nothing: a shortfall raises, the entry fails out, and
+        _admit's best-effort scrub releases whatever this method
+        recorded in _slot_pages first. Returns the prefill's first
+        sampled token."""
+        import functools
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_kubernetes.models.decode import paged_insert_row
+
+        st = self._state
+        jax = st._jax
+        logits, row, shared = self._prefill_paged(ids, width)
+        first = int(np.argmax(np.asarray(logits)[0]))
+        if st.ready:
+            LEDGER.emitted(1)
+        entry["_decoded"] = 1
+        if budget <= 1 or (st.eos_id is not None and first == st.eos_id):
+            # one-token budget or instant EOS: done without touching
+            # the pool at all
+            entry["tokens"] = [first]
+            return first
+        ps = self.page_size
+        n_prompt = width // ps
+        # +1 (when the span has room): the first decode write lands at
+        # position `width`, in the page AFTER the prompt — taking it
+        # now makes admission itself gate on the pool (free pages, not
+        # free slots, are the concurrency bound the paged engine
+        # advertises)
+        extra = 1 if n_prompt < self.max_pages else 0
+        need = n_prompt - len(shared) + extra
+        got = self._pages.allocate(need)
+        if got is None:
+            PAGE_STALLS.inc()
+            raise RuntimeError(
+                f"page pool exhausted at admission ({need} pages "
+                f"needed, {self._pages.free_count()} free)"
+            )
+        self._pages.ref(shared)
+        row_pages = shared + got[:n_prompt - len(shared)]
+        # record the holdings BEFORE the device scatter: if it throws,
+        # the scrub in _admit releases exactly these
+        self._slot_pages[slot] = row_pages + got[n_prompt - len(shared):]
+        self._table[slot, :] = 0
+        self._table[slot, :len(self._slot_pages[slot])] = \
+            self._slot_pages[slot]
+        skip = len(shared) * ps
+        ins = st._cached_program(
+            ("paged_insert", width, skip),
+            lambda: jax.jit(functools.partial(
+                paged_insert_row, skip=skip,
+            ), donate_argnums=(0,)),
+        )
+        self._pool = ins(self._pool, row,
+                         jnp.asarray(row_pages, jnp.int32))
+        return first
+
+    def _prefill_paged(self, ids: list, width: int):
+        """→ (last-position logits, full-width row cache, shared page
+        ids). The engine's OWN paged prefix store serves warm starts: a
+        hit gathers the pinned pages into the resume base (bytes
+        identical to what paged_insert_row scattered), prefills only
+        the suffix, and reports the pages so _admit_paged can reference
+        instead of copy them. Caller holds st._lock."""
+        import types
+
+        import jax.numpy as jnp
+
+        from tpu_kubernetes.models.decode import gather_pages
+
+        st = self._state
+        jax = st._jax
+        FAULTS.fire("serve.prefill")
+        q, entry = 0, None
+        if self._prefix is not None:
+            m, entry = self._prefix.lookup(ids)
+            q = _pow2_floor(min(m, len(ids) - 1))
+            if entry is None or q < MIN_PREFIX_TOKENS:
+                q, entry, result = 0, None, "miss"
+            else:
+                result = "hit" if m >= len(entry.ids) else "partial"
+            if st.ready:
+                PREFIX_CACHE_TOTAL.labels(result).inc()
+                if q:
+                    PREFIX_CACHED_TOKENS.observe(float(q))
+        if entry is None:
+            logits, row = st._prefill_cold(
+                st._pad_rows([ids], width), [len(ids)], width,
+            )
+            return logits, row, []
+        # q is pow2 >= MIN_PREFIX_TOKENS and page_size divides
+        # MIN_PREFIX_TOKENS, so the reuse length is page-aligned and
+        # q < len(ids) <= width keeps at least one suffix page to
+        # scatter
+        shared = list(entry.pages[:q // self.page_size])
+        gat = st._cached_program(
+            ("page_gather", len(shared)),
+            lambda: jax.jit(gather_pages),
+        )
+        base = gat(self._pool, jnp.asarray(shared, jnp.int32))
+        arrays = {"k": base.k, "v": base.v}
+        if base.k_scale is not None:
+            arrays["k_scale"] = base.k_scale
+            arrays["v_scale"] = base.v_scale
+        shim = types.SimpleNamespace(arrays=arrays)
+        logits, row = st._prefill_warm(ids, shim, q, width, width)
+        return logits, row, shared
+
+    def _prefix_store_paged(self, ids: list, slot: int) -> None:
+        """Pin the new row's whole-page prompt prefix into the paged
+        store (best-effort, like ServingState._prefix_insert): a True
+        insert pins the pages, so they outlive the slot for future
+        zero-copy warm hits. Whole pages only — the stored ids are
+        trimmed to a page boundary so ids→pages maps exactly. Must NOT
+        hold st._lock: an eviction this triggers wipes device pages."""
+        if self._prefix is None:
+            return
+        n_pages = len(ids) // self.page_size
+        n_store = n_pages * self.page_size
+        if n_store < MIN_PREFIX_TOKENS:
+            return
+        try:
+            FAULTS.fire("serve.prefix_insert")
+            pages = self._slot_pages[slot][:n_pages]
+            if self._prefix.insert_paged(
+                ids[:n_store], pages, n_pages * self._page_nbytes,
+            ):
+                self._pages.pin(pages)
+        except Exception as e:  # noqa: BLE001 — accelerator only
+            warn_once(
+                "prefix_insert_failed",
+                f"prefix-cache insert failed (serving continues without "
+                f"storing): {type(e).__name__}: {e}",
+            )
+
+    def _on_prefix_evict(self, entry) -> None:
+        """Paged store eviction → pool unpin; pages no resident slot
+        still reads come back wiped. PrefixCache fires this after
+        releasing its own lock, and the engine only mutates the store
+        outside st._lock, so the device wipe can take it."""
+        freed = self._pages.unpin(list(entry.pages))
+        self._wipe_pages(freed)
+        self._update_page_gauge()
+
+    def _wipe_pages(self, pages: list[int]) -> None:
+        """Device-wipe freed pages back to init values before the free
+        list reuses them — reuse of stale K/V would break the bitwise
+        cold-start guarantee the identity tests pin down. Chunked
+        through ONE compiled program: a fixed max_pages-length index
+        array padded with an out-of-range sentinel (scatter mode="drop"
+        ignores the padding)."""
+        if not pages:
+            return
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_kubernetes.models.decode import paged_clear_pages
+
+        st = self._state
+        jax = st._jax
+        clr = st._cached_program(
+            ("page_clear", self.max_pages),
+            lambda: jax.jit(paged_clear_pages, donate_argnums=(0,)),
+        )
+        sentinel = self._pages.total + 1
+        with st._lock:
+            for i in range(0, len(pages), self.max_pages):
+                chunk = np.full(self.max_pages, sentinel, np.int32)
+                part = pages[i:i + self.max_pages]
+                chunk[:len(part)] = part
+                self._pool = clr(self._pool, jnp.asarray(chunk))
+
+    def _topup_pages(self) -> None:
+        """Pre-segment host allocation: grow every live row's table to
+        cover the positions the next segment will write (compiled
+        programs never allocate — static shapes). Pool pressure
+        escalates in strict order: (1) drop the prefix store's pinned
+        pages, (2) preempt the YOUNGEST other resident row — greedy
+        decode is deterministic, so readmission re-emits its tokens
+        identically — (3) fail the row out (the pool cannot hold even
+        this one row). Each rung strictly shrinks demand, so the loop
+        terminates."""
+        import math
+
+        for i, entry in enumerate(self._entries):
+            if entry is None:
+                continue
+            adv = min(self.seg_steps, int(self._rem[i]))
+            need = min(
+                math.ceil((int(self._pos[i]) + adv) / self.page_size),
+                self.max_pages,
+            )
+            while (self._entries[i] is not None
+                   and len(self._slot_pages[i]) < need):
+                got = self._pages.allocate(
+                    need - len(self._slot_pages[i])
+                )
+                if got is not None:
+                    self._slot_pages[i].extend(got)
+                    n = len(self._slot_pages[i])
+                    self._table[i, n - len(got):n] = got
+                    continue
+                PAGE_STALLS.inc()
+                if self._prefix is not None and len(self._prefix):
+                    self._prefix.clear(notify=True)
+                    continue
+                victim = self._pick_victim(exclude=i)
+                if victim is not None:
+                    self._preempt(victim)
+                    continue
+                self._fail_entry(i, RuntimeError(
+                    f"page pool ({self._pages.total} pages) too small "
+                    f"for a resident row needing {need}"
+                ))
+        self._update_page_gauge()
+
+    def _pick_victim(self, exclude: int) -> int | None:
+        """Youngest resident row by admission order (it has the least
+        sunk decode work to recompute), never the row being topped up."""
+        best, best_seq = None, -1
+        for j, e in enumerate(self._entries):
+            if e is None or j == exclude:
+                continue
+            if self._admit_seq[j] > best_seq:
+                best, best_seq = j, int(self._admit_seq[j])
+        return best
+
+    def _preempt(self, slot: int) -> None:
+        """Evict a resident row to reclaim its pages, requeuing the
+        entry at the HEAD of the queue for recompute-from-scratch.
+        Tokens already decoded are settled shed-spent (that device work
+        is repeated); greedy determinism means the readmitted row
+        re-emits them identically, so preemption costs latency, never
+        correctness."""
+        PAGE_PREEMPTIONS.inc()
+        entry = self._entries[slot]
+        if self._state.ready:
+            LEDGER.settle("shed-spent", len(self._collected[slot]),
+                          device_s=entry.get("_device_s") or 0.0)
+        entry["_decoded"] = 0
+        entry["_device_s"] = 0.0
+        self._release_slot(slot)
+        with self._cond:
+            self._queue.insert(0, entry)
+            self._cond.notify()
+
+    def _fail_entry(self, slot: int, err: Exception) -> None:
+        """Fail ONE resident row out (the paged top-up dead end):
+        settle its decoded tokens shed-spent, surface the error, free
+        its pages. The engine keeps serving everything else."""
+        entry = self._entries[slot]
+        if self._state.ready:
+            LEDGER.settle("shed-spent", len(self._collected[slot]),
+                          device_s=entry.get("_device_s") or 0.0)
+        entry["error"] = err
+        entry["dispatched"].set()
+        entry["event"].set()
+        self._retire(slot)
+
+    def _update_page_gauge(self) -> None:
+        s = self._pages.stats()
+        for state in ("free", "live", "pinned"):
+            KV_PAGES.labels(state).set(s[state])
 
     def _run_segment(self) -> None:
         """One K-step mixed-batch segment, then drain finished rows.
@@ -729,13 +1139,33 @@ class _ContinuousEngine:
             return
         FAULTS.fire("serve.segment")
         steps = self.seg_steps
-        seg = st._cached_program(
-            ("slot_segment", steps),
-            lambda: jax.jit(functools.partial(
-                decode_segment_slots, cfg=st.cfg, steps=steps,
-                eos_id=st.eos_id, pad_id=0,
-            ), donate_argnums=(1,)),
-        )
+        if self.paged:
+            from tpu_kubernetes.models.decode import decode_segment_paged
+
+            # host-side allocation happens HERE, outside the compiled
+            # program: every live row's table must cover the positions
+            # this segment writes (may preempt or fail rows under pool
+            # pressure — re-check liveness after)
+            self._topup_pages()
+            if all(e is None for e in self._entries):
+                return
+            key = ("paged_segment", steps)
+            seg = st._cached_program(
+                key,
+                lambda: jax.jit(functools.partial(
+                    decode_segment_paged, cfg=st.cfg, steps=steps,
+                    eos_id=st.eos_id, pad_id=0,
+                ), donate_argnums=(1,)),
+            )
+        else:
+            key = ("slot_segment", steps)
+            seg = st._cached_program(
+                key,
+                lambda: jax.jit(functools.partial(
+                    decode_segment_slots, cfg=st.cfg, steps=steps,
+                    eos_id=st.eos_id, pad_id=0,
+                ), donate_argnums=(1,)),
+            )
         state = SlotState(
             tok=jnp.asarray(self._tok), pos=jnp.asarray(self._pos),
             remaining=jnp.asarray(self._rem),
@@ -746,16 +1176,21 @@ class _ContinuousEngine:
         row_steps = steps * self.slots
         t0 = time.perf_counter()
         with st._lock:
+            if self.paged:
+                args = (st.params, self._pool,
+                        jnp.asarray(self._table), state)
+            else:
+                args = (st.params, self._cache, state)
             PROFILER.record_cost(
-                "decode", seg, (st.params, self._cache, state),
-                tokens=row_steps, key=("slot_segment", steps),
+                "decode", seg, args, tokens=row_steps, key=key,
             )
             with PROFILER.phase(
-                "decode", key=("slot_segment", steps), tracer=TRACER,
+                "decode", key=key, tracer=TRACER,
             ) as pd:
-                toks, state, self._cache = pd.sync(
-                    seg(st.params, self._cache, state)
-                )
+                if self.paged:
+                    toks, state, self._pool = pd.sync(seg(*args))
+                else:
+                    toks, state, self._cache = pd.sync(seg(*args))
         elapsed = time.perf_counter() - t0
         toks = np.asarray(toks)
         new_pos = np.asarray(state.pos)
@@ -806,15 +1241,28 @@ class _ContinuousEngine:
         SLOT_OCCUPANCY.set(live / steps if resident else 0.0)
 
     def _clear_row(self, slot: int, best_effort: bool = False) -> None:
-        """cache_clear_row slot ``slot`` back to bitwise-cold. With
-        ``best_effort`` (the failed-insert scrub) a clear failure is
-        swallowed: the slot row is numerically inert for attention
-        either way, and the scrub must not mask the original error."""
+        """Reset slot ``slot`` back to bitwise-cold. Dense: the jitted
+        cache_clear_row wipe. Paged: zero the table row (every read and
+        write redirects to the page-0 sink), hand the page references
+        back to the pool, and device-wipe whichever pages that freed —
+        shared prefix pages stay resident for the store or other
+        readers. With ``best_effort`` (the failed-insert scrub) a clear
+        failure is swallowed: the row is numerically inert for
+        attention either way, and the scrub must not mask the original
+        error."""
         from tpu_kubernetes.models.decode import cache_clear_row
 
         st = self._state
         jax = st._jax
         try:
+            if self.paged:
+                pages, self._slot_pages[slot] = \
+                    self._slot_pages[slot], []
+                self._table[slot, :] = 0
+                freed = self._pages.release(pages)
+                self._wipe_pages(freed)
+                self._update_page_gauge()
+                return
             clr = st._cached_program(
                 ("slot_clear",),
                 lambda: jax.jit(cache_clear_row, donate_argnums=(0,)),
@@ -825,12 +1273,19 @@ class _ContinuousEngine:
             if not best_effort:
                 raise
 
-    def _retire(self, slot: int) -> None:
+    def _release_slot(self, slot: int) -> None:
+        """Free the slot's cache row and host mirrors WITHOUT counting
+        a recycle — shared by retirement (request finished its slot
+        lifetime) and paged preemption (request goes back to the
+        queue)."""
         self._clear_row(slot)
         self._entries[slot] = None
         self._collected[slot] = []
         self._pos[slot] = self._tok[slot] = self._rem[slot] = 0
         self._pl[slot] = self._ps[slot] = 0
+
+    def _retire(self, slot: int) -> None:
+        self._release_slot(slot)
         self.recycled += 1
         SLOTS_RECYCLED.inc()
 
@@ -862,9 +1317,28 @@ class _ContinuousEngine:
         for a in (self._pos, self._tok, self._rem, self._pl, self._ps):
             a[:] = 0
         st = self._state
-        self._cache = init_cache(
-            st.cfg, self.slots, self.span, kv_quant=st.kv_quant
-        )
+        if self.paged:
+            from tpu_kubernetes.models.decode import init_paged_pool
+            from tpu_kubernetes.serve.pages import PagePool
+
+            # reinitializing the pool invalidates every page id
+            # wholesale: drop the store WITHOUT unpin callbacks (the
+            # pages it would unpin no longer exist) and rebuild the
+            # accounting cold — conservation holds trivially again
+            if self._prefix is not None:
+                self._prefix.clear()
+            self._pool = init_paged_pool(
+                st.cfg, self._pages.total, self.page_size,
+                kv_quant=st.kv_quant,
+            )
+            self._pages = PagePool(self._pages.total)
+            self._table[:] = 0
+            self._slot_pages = [[] for _ in range(self.slots)]
+            self._update_page_gauge()
+        else:
+            self._cache = init_cache(
+                st.cfg, self.slots, self.span, kv_quant=st.kv_quant
+            )
         for e in affected:
             e["error"] = err
             e["dispatched"].set()
@@ -1116,6 +1590,20 @@ class ServingState:
                 ),
                 on_bytes=PREFIX_CACHE_BYTES.set,
             )
+        # SERVE_KV_POOL_MB (> 0 enables, engine only): back the slot
+        # engine with a paged KV pool instead of the dense
+        # (slots, max_seq) block — concurrency gates on LIVE tokens
+        # (free pages), not worst-case context. SERVE_KV_PAGE_SIZE sets
+        # the positions per page (power of two dividing the minimum
+        # prefix-reuse length, so warm hits stay page-aligned).
+        self.kv_page_size = int(env.get("SERVE_KV_PAGE_SIZE", "16") or 16)
+        self.kv_pool_mb = float(env.get("SERVE_KV_POOL_MB", "0") or 0)
+        if self.kv_pool_mb > 0 and not self._continuous:
+            raise ValueError(
+                "SERVE_KV_POOL_MB needs SERVE_CONTINUOUS_BATCHING=1 "
+                "(the page pool backs the slot engine; other request "
+                "modes keep their dense caches)"
+            )
         if self._continuous:
             # created LAST: the scheduler thread uses _prefill_any (the
             # prefix store included), so everything it leans on must be
@@ -1125,6 +1613,8 @@ class ServingState:
                 self, slots=batch if batch > 1 else 4,
                 seg_steps=(self.early_exit_steps
                            if self.early_exit_steps > 0 else 8),
+                page_size=self.kv_page_size,
+                pool_mb=self.kv_pool_mb,
             )
             # self-healing: a dead scheduler thread would hang every
             # future submitter — restart it cold, bounded times
@@ -2259,6 +2749,14 @@ class _Handler(BaseHTTPRequestHandler):
             # `tpu-kubernetes get goodput` renders
             payload = LEDGER.snapshot()
             payload["roofline"] = PROFILER.roofline()
+            st = self.state
+            if st._engine is not None and getattr(
+                st._engine, "paged", False
+            ):
+                # the page-pool partition next to the token classes:
+                # free+live+pinned must equal total (else a leak), and
+                # stalls explain bubble/shed-spent entries above
+                payload["kv_pages"] = st._engine._pages.stats()
             return self._json(200, payload)
         if self.path.startswith("/debug/trace/"):
             # the span tree of one request/run, looked up by the id the
