@@ -3,7 +3,7 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast obs-check monitor-check perf-check goodput-check serve-identity-check serve-continuous-check paged-check resilience-check bench dryrun native dist dist-offline clean
+.PHONY: test test-fast obs-check monitor-check perf-check goodput-check serve-identity-check serve-continuous-check paged-check sharded-check resilience-check bench dryrun native dist dist-offline clean
 
 test:
 	python -m pytest tests/ -q
@@ -42,8 +42,14 @@ monitor-check:
 # threshold against benchmarks/history/ is for. --require-baseline makes
 # a silently-deleted bench (a baselined metric absent from the run) fail
 # the gate instead of merely printing.
+# XLA_FLAGS: serve.sharded_continuous_decode needs >= 2 host devices and
+# the flag must be in the environment before jax's first import (perfbench
+# also claims it when it loads first, but an image sitecustomize can pull
+# jax in at interpreter start — the explicit export covers that case too).
 perf-check:
-	JAX_PLATFORMS=cpu python -m tpu_kubernetes bench run --suite all \
+	JAX_PLATFORMS=cpu \
+	  XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+	  python -m tpu_kubernetes bench run --suite all \
 	  --check --baseline benchmarks/baseline.jsonl --threshold 5.0 \
 	  --n 3 --warmup 2 --require-baseline
 
@@ -65,7 +71,7 @@ goodput-check:
 serve-identity-check:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py \
 	  tests/test_serve_prefix.py tests/test_serve_continuous.py \
-	  tests/test_ledger.py \
+	  tests/test_serve_sharded.py tests/test_ledger.py \
 	  -q -m "not slow" -k identity
 
 # Continuous-batching gate: the slot-engine unit + e2e tests, the full
@@ -88,9 +94,23 @@ serve-continuous-check:
 # slow-marked so tier-1 skips it but this target runs it).
 paged-check:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py \
-	  tests/test_serve_continuous.py tests/test_faults.py \
-	  tests/test_perfbench.py \
+	  tests/test_serve_continuous.py tests/test_serve_sharded.py \
+	  tests/test_faults.py tests/test_perfbench.py \
 	  -q -k paged
+
+# Sharded continuous-batching gate: the token-identity suite on the
+# forced 2-device CPU mesh (dense/paged/int8/warm-prefix/MoE gather +
+# grouped EP/mid-stream admission vs the single-device engine), the
+# mesh chaos matrix (serve.shard_segment), and the sharded-vs-dense
+# wall-time bound (slow-marked, so tier-1 skips it but this target
+# runs it). docs/guide/serving.md "Sharded continuous batching".
+sharded-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serve_sharded.py \
+	  "tests/test_faults.py::test_shard_segment_site_needs_a_mesh" \
+	  "tests/test_faults.py::test_sharded_chaos_conserves_pages_and_ledger" \
+	  "tests/test_faults.py::test_sharded_engine_restart_resets_pool_cold" \
+	  "tests/test_perfbench.py::test_sharded_continuous_decode_tracks_dense_engine" \
+	  -q
 
 # Resilience gate: the serve-path failure-handling suites — deadlines /
 # admission / drain / watchdog units and e2e (test_resilience.py), the

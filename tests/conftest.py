@@ -36,3 +36,23 @@ def tk_home(tmp_path, monkeypatch):
     """Hermetic ~/.tpu-kubernetes root."""
     monkeypatch.setenv("TPU_K8S_HOME", str(tmp_path / "tk-home"))
     return tmp_path / "tk-home"
+
+
+def cpu_mesh_devices(n: int = 2):
+    """The first ``n`` virtual CPU devices (the forced-8 pool above) —
+    the standing multi-device substrate for sharded-engine tests. The
+    MULTICHIP CI runs report no accelerator, so every mesh test that
+    wants to stay tier-1 builds its mesh from these."""
+    devs = jax.devices()
+    if len(devs) < n:  # pragma: no cover — the force-flag guarantees 8
+        pytest.skip(f"need {n} devices, have {len(devs)}")
+    return devs[:n]
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh():
+    """A 2-device ``tensor`` host mesh (parallel/mesh.py axis names) for
+    sharded serving/engine tests on CPU."""
+    from tpu_kubernetes.parallel import create_mesh
+
+    return create_mesh({"tensor": 2}, devices=cpu_mesh_devices(2))

@@ -97,7 +97,7 @@ def test_site_vocabulary_is_closed():
     test fails here until the matrix learns about it."""
     assert set(SITES) == {
         "serve.prefill", "serve.slot_insert", "serve.segment",
-        "serve.prefix_insert", "serve.page_alloc",
+        "serve.shard_segment", "serve.prefix_insert", "serve.page_alloc",
         "fleet.scrape", "shell.terraform",
     }
     assert ENV_VAR == "TPU_K8S_FAULTS"
@@ -343,11 +343,11 @@ def test_paged_deadline_reap_returns_pages(paged_chaos_server):
     _assert_pages_conserved(state)
 
 
-def test_paged_engine_restart_resets_pool_cold(paged_chaos_server):
-    """The watchdog-restart path in paged mode: a cold reset rebuilds
-    the pool with every page free (stored prefixes dropped wholesale —
-    their page ids died with the old pool) and serves immediately."""
-    state = paged_chaos_server.RequestHandlerClass.state
+def _restart_resets_pool_cold(state):
+    """The watchdog-restart contract in paged mode: a cold reset
+    rebuilds the pool with every page free (stored prefixes dropped
+    wholesale — their page ids died with the old pool) and serves
+    immediately. Shared by the single-device and sharded matrices."""
     state.complete(PROMPTS[2], max_new_tokens=4)     # populate store
     # quiesce first: restart() is dead-scheduler recovery — firing it
     # mid-retirement would shed-spent-settle a row complete() already
@@ -364,3 +364,87 @@ def test_paged_engine_restart_resets_pool_cold(paged_chaos_server):
     out = state.complete("pack my box", max_new_tokens=3)
     assert out["text"]
     _assert_pages_conserved(state)
+
+
+def test_paged_engine_restart_resets_pool_cold(paged_chaos_server):
+    _restart_resets_pool_cold(paged_chaos_server.RequestHandlerClass.state)
+
+
+# ---------------------------------------------------------------------------
+# sharded-engine chaos: serve.shard_segment on a forced 2-device mesh
+# ---------------------------------------------------------------------------
+
+# the sharded segment site only fires when the engine runs under
+# SERVE_MESH — the matrix below drives it on a 2-device host tensor mesh
+# (conftest forces 8 virtual CPU devices), in paged mode so both the
+# page-conservation and ledger-conservation invariants are live at once
+
+
+@pytest.fixture(scope="module")
+def sharded_chaos_server():
+    """A paged continuous-batching server under SERVE_MESH=tensor=2 —
+    the sharded program path that serve.shard_segment guards."""
+    from tpu_kubernetes.serve.server import make_server
+
+    srv = make_server(dict(
+        ENV, SERVER_HOST="127.0.0.1", SERVER_PORT="0",
+        SERVE_CONTINUOUS_BATCHING="1", SERVER_BATCH="2",
+        SERVE_PREFIX_CACHE_MB="4",
+        SERVE_KV_POOL_MB="0.25", SERVE_KV_PAGE_SIZE="16",
+        SERVE_MESH="tensor=2",
+    ))
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv
+    srv.shutdown()
+
+
+def test_shard_segment_site_needs_a_mesh(chaos_server):
+    """On a single-device engine the sharded site never fires: arming it
+    is a no-op, so a fleet-wide chaos spec can include it safely."""
+    state = chaos_server.RequestHandlerClass.state
+    assert state.mesh is None
+    with injected("serve.shard_segment:1.0"):
+        out = state.complete("pack my box", max_new_tokens=3)
+    assert out["text"]
+
+
+@pytest.mark.parametrize("prob", [1.0, 0.5])
+@pytest.mark.parametrize("site", ["serve.shard_segment", "serve.segment"])
+def test_sharded_chaos_conserves_pages_and_ledger(
+    sharded_chaos_server, site, prob,
+):
+    """Chaos on the mesh engine's decode segments: every request reaches
+    a terminal state, every page is handed back (sharded pool wipes and
+    fail-outs run the same donated programs as clean traffic), and the
+    goodput ledger's conservation sum holds."""
+    from tpu_kubernetes.obs.ledger import LEDGER
+
+    state = sharded_chaos_server.RequestHandlerClass.state
+    assert state.mesh is not None
+    before = LEDGER.snapshot(timeline=0)
+    with injected(f"{site}:{prob}:11"):
+        outs = _fan_out_chaotic(state, PROMPTS)
+    for o in outs:
+        assert o is not None
+        assert isinstance(o, (dict, Exception))
+    _assert_pages_conserved(state)
+    # chaos over: the sharded engine serves clean traffic immediately,
+    # and settlement converges back to the pre-test unsettled floor
+    ok = state.complete("pack my box", max_new_tokens=3)
+    assert ok["text"]
+    deadline = time.time() + 10
+    while (time.time() < deadline
+           and LEDGER.unsettled() != before["unsettled"]):
+        time.sleep(0.02)
+    after = LEDGER.snapshot(timeline=0)
+    assert after["unsettled"] == before["unsettled"]
+    assert (sum(after["classes"].values()) - sum(before["classes"].values())
+            == after["emitted"] - before["emitted"])
+    _assert_pages_conserved(state)
+
+
+def test_sharded_engine_restart_resets_pool_cold(sharded_chaos_server):
+    """The watchdog-restart path on a mesh: the rebuilt pool is sharded
+    again (device_put through the same kv shardings) and fully free."""
+    _restart_resets_pool_cold(sharded_chaos_server.RequestHandlerClass.state)

@@ -6,7 +6,9 @@ cold and warm-prefix, including a request admitted mid-decode while
 other rows hold their slots). Alongside identity: the engine's
 observability surface (slot-occupancy gauge, admission-wait histogram,
 recycled counter, /healthz engine stats) and the config gating
-(mesh/MoE warn-and-fall-back, prompt-lookup exclusivity).
+(MoE builds the engine — no fall-back — and prompt-lookup stays
+exclusive). The sharded (SERVE_MESH) engine has its own identity suite
+in tests/test_serve_sharded.py.
 """
 
 import http.client
@@ -256,15 +258,17 @@ def test_http_surfaces_engine_metrics_and_healthz(continuous_server):
 # ---------------------------------------------------------------------------
 
 
-def test_continuous_falls_back_for_moe():
-    """MoE expert capacity is batch-shaped (a co-rider could change a
-    response) — the engine must warn-and-fall-back, not build."""
+def test_continuous_builds_for_moe():
+    """MoE rides the slot engine: the fixed slot batch makes expert
+    capacity a constant shape no co-rider can change, so the old
+    warn-and-fall-back is gone — the engine must BUILD (the round-based
+    batcher stays off; the engine owns the greedy path)."""
     st = ServingState(dict(
         ENV, SERVE_MODEL="moe-test", SERVE_CONTINUOUS_BATCHING="1",
         SERVER_BATCH="4",
     ))
-    assert st._engine is None
-    assert st._batcher is None                # MoE skips the batcher too
+    assert st._engine is not None
+    assert st._batcher is None
 
 
 def test_continuous_rejects_prompt_lookup():

@@ -24,6 +24,16 @@ TPU-first decisions:
   single query token has no O(seq²) problem — flash buys nothing there);
   prefill reuses the training forward path (flash/Pallas on TPU).
 
+Sharding contract: every KV-bearing array this module allocates —
+row/slot caches ``(L, b, kv, S, d)``, paged pools
+``(L, pages+1, kv, page, d)``, and their int8 scales — keeps the
+kv-heads dimension at **axis 2**. parallel/serving.py's sharded-engine
+program builders key on that invariant (rank >= 4 ⇒ shard axis 2 on
+the ``tensor`` mesh axis; everything else — tokens, page tables,
+``SlotState`` — replicates), so the slot/paged primitives here run
+unchanged per shard under ``shard_map``. A new cache layout must either
+keep kv at axis 2 or teach ``kv_partition_spec`` its shape.
+
 MoE semantics: the routed layer runs per chunk (the prompt in prefill,
 one token per decode step), so expert-capacity dropping — whose threshold
 scales with the chunk's length — effectively never fires at decode time

@@ -88,6 +88,15 @@ MOE_CONFIGS: dict[str, MoEConfig] = {
         vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
         d_ff=128, max_seq=128, remat=False, n_experts=4, experts_per_token=2,
     ),
+    # the grouped twin of moe-test: exercises the dropless grouped path
+    # (and, inside an expert-parallel context, the all-to-all EP path in
+    # models/moe_ep.py) at test scale — the sharded-serving identity
+    # suite decodes this over an expert=2 host mesh
+    "moe-test-grouped": MoEConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=128, remat=False, n_experts=4, experts_per_token=2,
+        dispatch_mode="grouped",
+    ),
     "moe-1b": MoEConfig(
         vocab_size=32000, d_model=1024, n_layers=12, n_heads=16, n_kv_heads=8,
         d_ff=3584, max_seq=2048, n_experts=8, experts_per_token=2,

@@ -14,6 +14,35 @@ program and XLA propagates shardings onto it from the sharded K/V
 projections (cache kv-heads follow ``tensor``, batch follows data), so
 each chip holds only its slice of the cache.
 
+The continuous-batching slot engine (serve/server.py) is the exception:
+its caches ARE the API — a persistent (slots, max_seq) KV block (or
+paged pool) that insert/clear/segment programs mutate across calls. The
+second half of this module shards that engine. Every KV storage array
+models/decode.py defines keeps its kv-heads on axis 2 — dense k/v
+``(L, slots, kv, S, hd)`` and their scales ``(L, slots, kv, S)``, paged
+pools ``(L, pages+1, kv, ps[, hd])``, prefill row caches
+``(L, 1, kv, width[, hd])`` — so ONE PartitionSpec
+(:func:`kv_partition_spec`, heads over ``tensor`` via the
+logical-axis rules) shards all of them, and a rank test (>= 4-d = KV
+storage) tells them apart from the replicated operands (SlotState, page
+tables, slot indices, tokens, logits). Two builders split the work by
+what correctness needs:
+
+* :func:`kv_shard_map` — the pure data-movement primitives
+  (``cache_insert_row`` / ``cache_clear_row`` / ``paged_insert_row`` /
+  ``paged_clear_pages`` / ``gather_pages``) run under full-manual
+  ``shard_map``: no cross-shard math anywhere in their bodies, so each
+  shard performs bitwise the single-device program on its head slice.
+* :func:`kv_jit` — the transformer programs (prefill, resume, slot and
+  paged decode segments) run as ``jax.jit`` with explicit, stable
+  NamedShardings and GSPMD-inserted collectives (the same mechanism as
+  :func:`make_sharded_generate`, which the serving identity suite pins
+  down as bitwise token-identical on a host mesh). Full-manual
+  shard_map would silently drop the attention-output/MLP psums, and
+  partial-manual shard_map needs jax >= 0.8 (parallel/compat.py), so
+  explicit-sharding jit is the one mechanism that is exact on every
+  runtime this tree supports.
+
 Works with raw bf16 params or the int8 export (models/quant.py): the
 quantized ``{"q", "s"}`` leaves carry the same logical axes as the
 weights they replace, scales sharded like the output channel they scale.
@@ -34,7 +63,12 @@ from tpu_kubernetes.models import logical_axes
 from tpu_kubernetes.models.decode import generate
 from tpu_kubernetes.models.llama import ModelConfig
 from tpu_kubernetes.models.quant import is_quantized
-from tpu_kubernetes.parallel.mesh import batch_sharding, param_shardings
+from tpu_kubernetes.parallel.compat import shard_map_compat
+from tpu_kubernetes.parallel.mesh import (
+    batch_sharding,
+    logical_to_spec,
+    param_shardings,
+)
 
 
 def serving_param_shardings(params: dict, cfg: ModelConfig, mesh: Mesh):
@@ -69,6 +103,7 @@ def make_sharded_generate(
     max_new_tokens: int, temperature: float = 0.0, top_k: int = 0,
     top_p: float = 0.0, eos_id: int | None = None, pad_id: int = 0,
     cache_span: int | None = None, kv_quant: bool = False,
+    shard_batch: bool = True,
 ) -> tuple[Callable, Any, NamedSharding]:
     """→ (generate_fn(params, prompt, rng=None, prompt_lengths=None) ->
     tokens, param shardings, prompt sharding). Mirrors
@@ -80,9 +115,14 @@ def make_sharded_generate(
     omitted, it defaults to a fixed key (fine for greedy decoding).
     ``prompt_lengths`` serves a right-padded ragged batch (replicated —
     it is (batch,) int32, bytes not worth sharding); ``eos_id``/``pad_id``
-    are static per compiled program like the sampling knobs."""
+    are static per compiled program like the sampling knobs.
+    ``shard_batch=False`` replicates the prompt instead of sharding it
+    over the data-like axes — what a live server passes, since its
+    requests are batch-1 rows an ``expert``-axis mesh (MoE serving)
+    could not split."""
     p_shardings = serving_param_shardings(params, cfg, mesh)
-    prompt_sharding = batch_sharding(mesh)
+    prompt_sharding = (batch_sharding(mesh) if shard_batch
+                       else NamedSharding(mesh, PartitionSpec()))
     replicated = NamedSharding(mesh, PartitionSpec())
 
     def _gen(params, prompt, rng, prompt_lengths=None):
@@ -113,3 +153,90 @@ def make_sharded_generate(
         return jitted_ragged(params, prompt, rng, prompt_lengths)
 
     return run, p_shardings, prompt_sharding
+
+
+# -- sharded continuous batching: the slot/paged engine's program set -------
+
+
+def kv_partition_spec(mesh: Mesh) -> PartitionSpec:
+    """The one KV-storage sharding rule: every cache array in
+    models/decode.py keeps its kv-heads on axis 2 — dense k/v
+    ``(L, slots, kv, S, hd)``, scales ``(L, slots, kv, S)``, paged
+    pools ``(L, pages+1, kv, ps[, hd])``, prefill row caches
+    ``(L, 1, kv, width[, hd])`` — so heads shard over ``tensor``
+    (DEFAULT_RULES ``kv`` → ``tensor``; dropped when the mesh has no
+    non-trivial tensor axis) and every other dim replicates (trailing
+    dims are implicitly replicated by PartitionSpec)."""
+    return logical_to_spec((None, None, "kv"), mesh=mesh)
+
+
+def _kv_spec_of(leaf, kv: PartitionSpec) -> PartitionSpec:
+    # rank test: in the slot/paged engine's programs, KV storage is the
+    # only operand >= 4-d; SlotState rows, page tables, slot indices,
+    # tokens, and logits are all <= 2-d and replicate
+    return kv if getattr(leaf, "ndim", 0) >= 4 else PartitionSpec()
+
+
+def kv_tree_shardings(tree: Any, mesh: Mesh) -> Any:
+    """NamedShardings for a KVCache / PagedKVCache pytree (or any tree
+    mixing KV storage with small replicated leaves) under the
+    :func:`kv_partition_spec` rule — what the engine ``jax.device_put``s
+    its persistent caches with at init and after a cold reset."""
+    kv = kv_partition_spec(mesh)
+    return jax.tree_util.tree_map(
+        lambda a: NamedSharding(mesh, _kv_spec_of(a, kv)), tree
+    )
+
+
+def kv_shard_map(fn: Callable, mesh: Mesh, example_args: tuple,
+                 donate_argnums: tuple = ()) -> Callable:
+    """Run a pure data-movement slot/paged primitive (insert, clear,
+    page wipe, page gather) under FULL-MANUAL shard_map: KV storage
+    shards by :func:`kv_partition_spec`, everything else (slot indices,
+    page-id vectors, row metadata) replicates. The bodies do no
+    cross-shard math — pads, dynamic_update_slices, and gathers along
+    non-head axes — so each shard computes bitwise the single-device
+    program on its head slice; full-manual mode (empty ``axis_names``)
+    compiles on every jax this tree supports (parallel/compat.py).
+    ``example_args`` fixes the in/out pytree structure (via
+    ``jax.eval_shape``) so None-able KVCache fields resolve; matching
+    in/out specs keep ``donate_argnums`` effective across the engine's
+    repeated calls."""
+    kv = kv_partition_spec(mesh)
+    in_specs = jax.tree_util.tree_map(
+        lambda a: _kv_spec_of(a, kv), tuple(example_args)
+    )
+    out_specs = jax.tree_util.tree_map(
+        lambda a: _kv_spec_of(a, kv), jax.eval_shape(fn, *example_args)
+    )
+    mapped = shard_map_compat(fn, mesh, in_specs, out_specs,
+                              check_vma=False)
+    return jax.jit(mapped, donate_argnums=donate_argnums)
+
+
+def kv_jit(fn: Callable, mesh: Mesh, example_args: tuple, *,
+           params_shardings: Any = None,
+           donate_argnums: tuple = ()) -> Callable:
+    """jit a model program (prefill, resume, slot/paged decode segment)
+    for the mesh with explicit, STABLE shardings: arg 0 is the params
+    pytree (``params_shardings`` from :func:`serving_param_shardings`),
+    KV storage shards by :func:`kv_partition_spec`, and everything else
+    — tokens, lengths, SlotState, page tables, logits — replicates.
+    Outputs follow the same rank rule (via ``jax.eval_shape``), so a
+    donated cache keeps one sharding across every segment (GSPMD never
+    drifts the layout between calls, and donation actually reuses the
+    buffer). GSPMD inserts the collectives, the mechanism the serving
+    identity suite pins down as bitwise token-identical to
+    single-device decode on a host mesh."""
+    kv = kv_partition_spec(mesh)
+
+    def shard_of(leaf):
+        return NamedSharding(mesh, _kv_spec_of(leaf, kv))
+
+    args = tuple(example_args)
+    in_sh = [jax.tree_util.tree_map(shard_of, a) for a in args]
+    if params_shardings is not None:
+        in_sh[0] = params_shardings
+    out_sh = jax.tree_util.tree_map(shard_of, jax.eval_shape(fn, *args))
+    return jax.jit(fn, in_shardings=tuple(in_sh), out_shardings=out_sh,
+                   donate_argnums=donate_argnums)

@@ -68,9 +68,13 @@ SERVE_CONTINUOUS_BATCHING (persistent slot-engine decode: requests are
 admitted into the running batch between segments, SERVER_BATCH doubling
 as the slot count) and SERVE_KV_POOL_MB/SERVE_KV_PAGE_SIZE (paged KV
 cache for the slot engine: one shared page pool, admission gated on
-free pages, warm prefixes pinned zero-copy) — all documented there; the
-batch job runs one fused program per batch, so per-request
-caching/early-exit/slot/page scheduling does not apply here.
+free pages, warm prefixes pinned zero-copy) — all documented there.
+SERVE_MESH composes with the server's slot engine end to end (KV and
+the page pool shard over kv heads, MoE decode routes expert-parallel
+on an ``expert`` axis — docs/guide/serving.md "Sharded continuous
+batching"); the batch job runs one fused program per batch, so
+per-request caching/early-exit/slot/page scheduling does not apply
+here.
 
 The reference provisioner has no inference plane (SURVEY §0); this
 completes the in-tree stack's serving story end to end (provision →

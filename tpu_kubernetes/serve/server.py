@@ -58,10 +58,15 @@ SERVE_CONTINUOUS_BATCHING (=1: greedy default requests serve through a
 persistent slot-based decode engine instead of round-based batching —
 new requests are admitted into the running batch as finished rows
 drain, per-row width buckets, SERVER_BATCH doubles as the slot count;
-dense single-device only, warn-and-fall-back otherwise),
-SERVE_MESH (e.g. ``tensor=4``) — tensor-sharded fused generation over
-this host's chips, so models bigger than one chip's HBM serve live
-(streaming and prompt-lookup stay single-device and say so) — and
+composes with SERVE_MESH — the engine's caches shard kv-heads over the
+tensor axis and its programs run sharded (parallel/serving.py) — and
+with MoE models, whose slots ride the same engine),
+SERVE_MESH (e.g. ``tensor=4``) — tensor-sharded serving over this
+host's chips, so models bigger than one chip's HBM serve live: the
+fused generate program for sampled requests, and the slot engine
+end-to-end when continuous batching is on (``expert`` axes are allowed
+for MoE models; streaming and prompt-lookup stay single-device and say
+so) — and
 SERVE_PROMPT_LOOKUP (+SERVE_DRAFT_K/SERVE_NGRAM) — draft-model-free
 speculative decoding for greedy requests, streaming included: host-side
 n-gram proposals verified by one jitted (k+1)-token chunk per round, so
@@ -496,11 +501,20 @@ class _ContinuousEngine:
     ONE segment program (the batch shape is fixed). Entries share the
     _Batcher dict shape so complete() consumes both identically, and
     per-row decode is token-identical to solo greedy (models/decode.py
-    SlotState — the ragged-row independence argument, which is also why
-    MoE serves round-based instead: its expert capacity is
-    batch-shaped). The generation lock is taken per prefill/segment and
-    released between, so solo/streaming/sampled requests interleave
-    with a busy engine."""
+    SlotState — the ragged-row independence argument; MoE rides the
+    same engine because the fixed slot batch makes expert capacity a
+    constant shape no co-rider can change). The generation lock is
+    taken per prefill/segment and released between, so
+    solo/streaming/sampled requests interleave with a busy engine.
+
+    Under SERVE_MESH the engine runs SHARDED end-to-end: the persistent
+    caches are device_put with KV heads over the tensor axis
+    (parallel/serving.py kv_tree_shardings), data-movement programs run
+    under full-manual shard_map (_kv_program), model programs under
+    explicit-sharding jit (_model_program), and page tables / SlotState
+    stay replicated — deadline reaps, engine restarts, and prefix
+    pins/resumes all operate on the sharded arrays through the same
+    code paths."""
 
     def __init__(self, state: "ServingState", slots: int, seg_steps: int,
                  page_size: int = 16, pool_mb: float = 0.0):
@@ -512,6 +526,14 @@ class _ContinuousEngine:
         self.slots = slots
         self.seg_steps = max(1, seg_steps)
         self.span = state.cfg.max_seq
+        ep = (state.mesh.shape.get("expert", 1)
+              if state.mesh is not None else 1)
+        if ep > 1 and slots % ep:
+            raise ValueError(
+                f"SERVER_BATCH ({slots} slots) must be divisible by "
+                f"the expert mesh axis ({ep}) — expert-parallel decode "
+                "splits the slot batch over experts"
+            )
         self._cond = threading.Condition()
         self._queue: list[dict] = []
         # host-side slot table: _entries[i] is the request occupying
@@ -576,9 +598,9 @@ class _ContinuousEngine:
                     f"each); one full-span row needs {self.max_pages}"
                 )
             self._pages = PagePool(num_pages)
-            self._pool = init_paged_pool(
+            self._pool = self._shard_kv(init_paged_pool(
                 state.cfg, num_pages, ps, kv_quant=state.kv_quant
-            )
+            ))
             self._table = np.zeros((slots, self.max_pages), np.int32)
             self._slot_pages: list[list[int]] = [[] for _ in range(slots)]
             # admission order, for youngest-first preemption
@@ -599,11 +621,24 @@ class _ContinuousEngine:
                 )
             self._update_page_gauge()
         else:
-            self._cache = init_cache(
+            self._cache = self._shard_kv(init_cache(
                 state.cfg, slots, self.span, kv_quant=state.kv_quant
-            )
+            ))
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+
+    def _shard_kv(self, tree):
+        """device_put freshly initialized KV storage with the mesh's
+        head-sharded layout (parallel/serving.py kv_tree_shardings) —
+        identity on single-device serving. Applied at engine init and
+        after a cold reset, so every program sees ONE stable sharding
+        for the engine's whole life."""
+        st = self._state
+        if st.mesh is None:
+            return tree
+        from tpu_kubernetes.parallel.serving import kv_tree_shardings
+
+        return st._jax.device_put(tree, kv_tree_shardings(tree, st.mesh))
 
     def enqueue(self, ids: list, max_new: int,
                 deadline: float | None = None,
@@ -784,7 +819,6 @@ class _ContinuousEngine:
 
         FAULTS.fire("serve.slot_insert")
         st = self._state
-        jax = st._jax
         ids, budget = entry["ids"], entry["budget"]
         width = _bucket(len(ids))
         t0 = time.perf_counter()
@@ -812,11 +846,9 @@ class _ContinuousEngine:
                     # one-token budget or instant EOS: done, no slot
                     entry["tokens"] = [first]
                 else:
-                    ins = st._cached_program(
-                        ("slot_insert",),
-                        lambda: jax.jit(
-                            cache_insert_row, donate_argnums=(0,)
-                        ),
+                    ins = st._kv_program(
+                        ("slot_insert",), cache_insert_row,
+                        (self._cache, row, slot), donate=(0,),
                     )
                     self._cache = ins(self._cache, row, slot)
         entry["_device_s"] = time.perf_counter() - t0
@@ -902,14 +934,13 @@ class _ContinuousEngine:
         self._table[slot, :len(self._slot_pages[slot])] = \
             self._slot_pages[slot]
         skip = len(shared) * ps
-        ins = st._cached_program(
+        pages_arr = jnp.asarray(row_pages, jnp.int32)
+        ins = st._kv_program(
             ("paged_insert", width, skip),
-            lambda: jax.jit(functools.partial(
-                paged_insert_row, skip=skip,
-            ), donate_argnums=(0,)),
+            functools.partial(paged_insert_row, skip=skip),
+            (self._pool, row, pages_arr), donate=(0,),
         )
-        self._pool = ins(self._pool, row,
-                         jnp.asarray(row_pages, jnp.int32))
+        self._pool = ins(self._pool, row, pages_arr)
         return first
 
     def _prefill_paged(self, ids: list, width: int):
@@ -926,7 +957,6 @@ class _ContinuousEngine:
         from tpu_kubernetes.models.decode import gather_pages
 
         st = self._state
-        jax = st._jax
         FAULTS.fire("serve.prefill")
         q, entry = 0, None
         if self._prefix is not None:
@@ -950,11 +980,12 @@ class _ContinuousEngine:
         # q < len(ids) <= width keeps at least one suffix page to
         # scatter
         shared = list(entry.pages[:q // self.page_size])
-        gat = st._cached_program(
-            ("page_gather", len(shared)),
-            lambda: jax.jit(gather_pages),
+        shared_arr = jnp.asarray(shared, jnp.int32)
+        gat = st._kv_program(
+            ("page_gather", len(shared)), gather_pages,
+            (self._pool, shared_arr),
         )
-        base = gat(self._pool, jnp.asarray(shared, jnp.int32))
+        base = gat(self._pool, shared_arr)
         arrays = {"k": base.k, "v": base.v}
         if base.k_scale is not None:
             arrays["k_scale"] = base.k_scale
@@ -1014,18 +1045,18 @@ class _ContinuousEngine:
         from tpu_kubernetes.models.decode import paged_clear_pages
 
         st = self._state
-        jax = st._jax
-        clr = st._cached_program(
-            ("page_clear", self.max_pages),
-            lambda: jax.jit(paged_clear_pages, donate_argnums=(0,)),
-        )
         sentinel = self._pages.total + 1
         with st._lock:
             for i in range(0, len(pages), self.max_pages):
                 chunk = np.full(self.max_pages, sentinel, np.int32)
                 part = pages[i:i + self.max_pages]
                 chunk[:len(part)] = part
-                self._pool = clr(self._pool, jnp.asarray(chunk))
+                chunk_arr = jnp.asarray(chunk)
+                clr = st._kv_program(
+                    ("page_clear", self.max_pages), paged_clear_pages,
+                    (self._pool, chunk_arr), donate=(0,),
+                )
+                self._pool = clr(self._pool, chunk_arr)
 
     def _topup_pages(self) -> None:
         """Pre-segment host allocation: grow every live row's table to
@@ -1134,14 +1165,17 @@ class _ContinuousEngine:
         )
 
         st = self._state
-        jax = st._jax
         if all(e is None for e in self._entries):
             return
         FAULTS.fire("serve.segment")
+        if st.mesh is not None:
+            # the sharded-segment chaos site: a mesh-mode segment can
+            # die in the sharded program itself (a chip drops out of
+            # the collective) — injected failures here must leave page
+            # and ledger conservation intact
+            FAULTS.fire("serve.shard_segment")
         steps = self.seg_steps
         if self.paged:
-            from tpu_kubernetes.models.decode import decode_segment_paged
-
             # host-side allocation happens HERE, outside the compiled
             # program: every live row's table must cover the positions
             # this segment writes (may preempt or fail rows under pool
@@ -1149,23 +1183,6 @@ class _ContinuousEngine:
             self._topup_pages()
             if all(e is None for e in self._entries):
                 return
-            key = ("paged_segment", steps)
-            seg = st._cached_program(
-                key,
-                lambda: jax.jit(functools.partial(
-                    decode_segment_paged, cfg=st.cfg, steps=steps,
-                    eos_id=st.eos_id, pad_id=0,
-                ), donate_argnums=(1,)),
-            )
-        else:
-            key = ("slot_segment", steps)
-            seg = st._cached_program(
-                key,
-                lambda: jax.jit(functools.partial(
-                    decode_segment_slots, cfg=st.cfg, steps=steps,
-                    eos_id=st.eos_id, pad_id=0,
-                ), donate_argnums=(1,)),
-            )
         state = SlotState(
             tok=jnp.asarray(self._tok), pos=jnp.asarray(self._pos),
             remaining=jnp.asarray(self._rem),
@@ -1177,10 +1194,28 @@ class _ContinuousEngine:
         t0 = time.perf_counter()
         with st._lock:
             if self.paged:
+                from tpu_kubernetes.models.decode import (
+                    decode_segment_paged,
+                )
+
+                key = ("paged_segment", steps)
                 args = (st.params, self._pool,
                         jnp.asarray(self._table), state)
+                seg = st._model_program(
+                    key, functools.partial(
+                        decode_segment_paged, cfg=st.cfg, steps=steps,
+                        eos_id=st.eos_id, pad_id=0,
+                    ), args, donate=(1,), ep=True,
+                )
             else:
+                key = ("slot_segment", steps)
                 args = (st.params, self._cache, state)
+                seg = st._model_program(
+                    key, functools.partial(
+                        decode_segment_slots, cfg=st.cfg, steps=steps,
+                        eos_id=st.eos_id, pad_id=0,
+                    ), args, donate=(1,), ep=True,
+                )
             PROFILER.record_cost(
                 "decode", seg, args, tokens=row_steps, key=key,
             )
@@ -1253,7 +1288,6 @@ class _ContinuousEngine:
         from tpu_kubernetes.models.decode import cache_clear_row
 
         st = self._state
-        jax = st._jax
         try:
             if self.paged:
                 pages, self._slot_pages[slot] = \
@@ -1263,11 +1297,11 @@ class _ContinuousEngine:
                 self._wipe_pages(freed)
                 self._update_page_gauge()
                 return
-            clr = st._cached_program(
-                ("slot_clear",),
-                lambda: jax.jit(cache_clear_row, donate_argnums=(0,)),
-            )
             with st._lock:
+                clr = st._kv_program(
+                    ("slot_clear",), cache_clear_row,
+                    (self._cache, slot), donate=(0,),
+                )
                 self._cache = clr(self._cache, slot)
         except Exception:  # noqa: BLE001 — scrub only
             if not best_effort:
@@ -1327,18 +1361,18 @@ class _ContinuousEngine:
             # accounting cold — conservation holds trivially again
             if self._prefix is not None:
                 self._prefix.clear()
-            self._pool = init_paged_pool(
+            self._pool = self._shard_kv(init_paged_pool(
                 st.cfg, self._pages.total, self.page_size,
                 kv_quant=st.kv_quant,
-            )
+            ))
             self._pages = PagePool(self._pages.total)
             self._table[:] = 0
             self._slot_pages = [[] for _ in range(self.slots)]
             self._update_page_gauge()
         else:
-            self._cache = init_cache(
+            self._cache = self._shard_kv(init_cache(
                 st.cfg, self.slots, self.span, kv_quant=st.kv_quant
-            )
+            ))
         for e in affected:
             e["error"] = err
             e["dispatched"].set()
@@ -1416,15 +1450,21 @@ class ServingState:
         self._http_server = None     # set by make_server (drain shutdown)
         self._watchdog = None
 
-        # SERVE_MESH (e.g. "tensor=4"): serve the fused path TENSOR-
-        # SHARDED over this host's chips (parallel/serving.py) — models
-        # bigger than one chip's HBM serve live. Batch-carrying axes are
-        # rejected (requests are batch-1 rows; sharding the batch dim
-        # would make single requests unshardable), and the streaming /
-        # prompt-lookup paths stay single-device by design.
+        # SERVE_MESH (e.g. "tensor=4"): serve TENSOR-SHARDED over this
+        # host's chips (parallel/serving.py) — models bigger than one
+        # chip's HBM serve live, and the continuous slot engine shards
+        # its persistent KV caches the same way. Batch-carrying axes
+        # are rejected (requests are batch-1 rows; sharding the batch
+        # dim would make single requests unshardable) — except
+        # ``expert`` for MoE models, where it shards expert weights and
+        # decode segments route through the expert-parallel grouped
+        # path (models/moe_ep.py). The streaming / prompt-lookup paths
+        # stay single-device by design.
         self.mesh = None
+        self._p_shardings = None
         mesh_spec = env.get("SERVE_MESH", "")
         if mesh_spec:
+            from tpu_kubernetes.models import MoEConfig
             from tpu_kubernetes.parallel import (
                 create_mesh,
                 device_prefix_for,
@@ -1447,19 +1487,34 @@ class ServingState:
             except TopologyError as e:
                 # main() maps ValueError to a one-line config diagnostic
                 raise ValueError(f"SERVE_MESH: {e}") from e
-            bad = [a for a in shape if a in DATA_AXES and shape[a] > 1]
+            bad = [
+                a for a in shape
+                if a in DATA_AXES and shape[a] > 1
+                and not (a == "expert" and isinstance(cfg, MoEConfig))
+            ]
             if bad:
                 raise ValueError(
                     f"SERVE_MESH axes {bad} shard the batch — live "
-                    "requests are batch-1; use tensor (or sequence) axes"
+                    "requests are batch-1; use tensor (or sequence) "
+                    "axes (expert is allowed for MoE models)"
                 )
             devs = device_prefix_for(
                 shape, jax.devices(), label="SERVE_MESH"
             )
+            # after the device-count check: a mesh that cannot even be
+            # built diagnoses as "not enough devices", not head math
+            t = int(shape.get("tensor", 1))
+            if t > 1 and cfg.n_kv_heads % t:
+                raise ValueError(
+                    f"SERVE_MESH tensor={t} must divide n_kv_heads "
+                    f"({cfg.n_kv_heads}) — the slot engine shards KV "
+                    "heads over the tensor axis"
+                )
             self.mesh = create_mesh(shape, devices=devs)
-            self.params = jax.device_put(
-                params, serving_param_shardings(params, cfg, self.mesh)
+            self._p_shardings = serving_param_shardings(
+                params, cfg, self.mesh
             )
+            self.params = jax.device_put(params, self._p_shardings)
             log.info(f"server: sharded serving: mesh={dict(self.mesh.shape)}")
         # jitted programs keyed by their STATIC arguments — jax.jit's own
         # cache keys on callable identity, so a fresh partial per request
@@ -1478,10 +1533,11 @@ class ServingState:
         # with the persistent slot engine (_ContinuousEngine) for greedy
         # default requests. SERVER_BATCH doubles as the slot count
         # (default 4 when unset/1 — slots are decode-batch rows, so the
-        # same sizing intuition applies). Dense single-device only, the
-        # prefix cache's warn-and-fall-back pattern: sharded serving is
-        # fused (no incremental decode to admit into) and MoE capacity
-        # is batch-shaped (a co-rider could change a response).
+        # same sizing intuition applies). Composes with SERVE_MESH —
+        # the engine's caches and programs shard (parallel/serving.py)
+        # — and with MoE: the engine always decodes at the fixed slot
+        # batch, so expert capacity is a constant shape no co-rider can
+        # change, and per-row tokens stay identical to solo greedy.
         continuous = truthy_env(env, "SERVE_CONTINUOUS_BATCHING")
         if continuous and self.prompt_lookup:
             raise ValueError(
@@ -1489,15 +1545,6 @@ class ServingState:
                 "exclusive owners of the greedy path (speculation is "
                 "batch-1; the engine is a persistent batch) — pick one"
             )
-        if continuous and (self.mesh is not None
-                           or isinstance(cfg, MoEConfig)):
-            warn_once(
-                "continuous_mesh_moe",
-                "SERVE_CONTINUOUS_BATCHING ignored: the slot engine "
-                "needs a single-device dense model (sharded serving is "
-                "fused; MoE capacity is batch-width-dependent)",
-            )
-            continuous = False
         self._continuous = continuous
 
         if self.prompt_lookup:
@@ -1525,10 +1572,12 @@ class ServingState:
                     "amortizes throughput) — pick one"
                 )
 
-        if batch > 1 and isinstance(cfg, MoEConfig):
-            # the ragged-row identity batching leans on is weaker for MoE
-            # (capacity is computed at the padded width — co-riders could
-            # change a response); serve MoE solo rather than quietly
+        if batch > 1 and isinstance(cfg, MoEConfig) and not continuous:
+            # the ragged-row identity ROUND batching leans on is weaker
+            # for MoE (capacity is computed at the padded width —
+            # co-riders could change a response); serve MoE solo rather
+            # than quietly. The slot engine is exempt: it always decodes
+            # at the fixed slot batch, so capacity is a constant shape.
             warn_once(
                 "batch_moe",
                 "SERVER_BATCH ignored: MoE capacity is batch-width-"
@@ -1564,21 +1613,14 @@ class ServingState:
         # a stored prefix prefills only its suffix — into the SAME cache
         # geometry as a cold prefill, so every downstream program is
         # shared and greedy tokens stay identical (up to the documented
-        # chunked-scoring float caveat, prefill_chunked). Single-device
-        # dense models only: the fused sharded path has no resume form,
-        # and MoE capacity depends on the prefill chunk length (reuse
-        # would not be token-exact) — both warn and serve cold.
+        # chunked-scoring float caveat, prefill_chunked). On a mesh the
+        # stored segments are the sharded engine's own prefill output —
+        # resume programs reshard them via explicit in_shardings, so
+        # warm starts serve sharded too (pinned PAGES, in paged mode,
+        # already live sharded in the pool).
         self.prefix_cache = None
         prefix_mb = float(env.get("SERVE_PREFIX_CACHE_MB", "0") or "0")
-        if prefix_mb > 0 and (self.mesh is not None
-                              or isinstance(cfg, MoEConfig)):
-            warn_once(
-                "prefix_cache_mesh_moe",
-                "SERVE_PREFIX_CACHE_MB ignored: prefix reuse needs a "
-                "single-device dense model (sharded serving is fused; "
-                "MoE capacity is chunk-length-dependent)",
-            )
-        elif prefix_mb > 0:
+        if prefix_mb > 0:
             from tpu_kubernetes.serve.prefix_cache import PrefixCache
 
             self.prefix_cache = PrefixCache(
@@ -1766,6 +1808,70 @@ class ServingState:
                 fn = self._programs[key] = build()
         return fn
 
+    def _kv_program(self, key, fn, example_args, donate: tuple = ()):
+        """Get-or-create one of the slot engine's DATA-MOVEMENT programs
+        (insert / clear / page wipe / page gather): a plain jax.jit on
+        single-device serving, full-manual shard_map over the mesh's
+        tensor-sharded KV otherwise (parallel/serving.py kv_shard_map —
+        the bodies do no cross-shard math, so each shard runs bitwise
+        the single-device program on its head slice). ``example_args``
+        must be the concrete call arguments — they fix the in/out specs
+        at build time and the first call follows immediately."""
+        def build():
+            if self.mesh is None:
+                return self._jax.jit(fn, donate_argnums=donate)
+            from tpu_kubernetes.parallel.serving import kv_shard_map
+
+            return kv_shard_map(fn, self.mesh, example_args,
+                                donate_argnums=donate)
+
+        return self._cached_program(key, build)
+
+    def _model_program(self, key, fn, example_args, donate: tuple = (),
+                       ep: bool = False):
+        """Get-or-create one of the slot engine's MODEL programs
+        (prefill, resume, decode segments): a plain jax.jit on
+        single-device serving, explicit-sharding jit on a mesh
+        (parallel/serving.py kv_jit — params by their logical axes, KV
+        storage heads-over-tensor, everything else replicated; GSPMD
+        inserts the collectives). With ``ep`` (decode segments only —
+        their batch is the fixed slot count) MoE bodies trace under
+        expert_parallel_context, routing expert MLPs through the
+        grouped all-to-all path (models/moe_ep.py) per segment."""
+        def build():
+            if self.mesh is None:
+                return self._jax.jit(fn, donate_argnums=donate)
+            from tpu_kubernetes.parallel.serving import kv_jit
+
+            body = self._ep_wrap(fn) if ep else fn
+            return kv_jit(body, self.mesh, example_args,
+                          params_shardings=self._p_shardings,
+                          donate_argnums=donate)
+
+        return self._cached_program(key, build)
+
+    def _ep_wrap(self, fn):
+        """Trace ``fn`` under expert_parallel_context when the mesh has
+        a non-trivial expert axis, so MoE layers with
+        dispatch_mode="grouped" shard_map themselves over it (identity
+        wrapper otherwise — the context is trace-time, models/moe_ep.py).
+        Applied to decode segments only: their batch is the fixed slot
+        count, which admission checks divides the expert axis; prefill
+        rows are batch-1 and stay on the GSPMD path."""
+        if self.mesh is None or self.mesh.shape.get("expert", 1) <= 1:
+            return fn
+
+        import functools
+
+        from tpu_kubernetes.models.moe_ep import expert_parallel_context
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with expert_parallel_context(self.mesh):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
     def _program(self, max_new: int, temperature: float, top_k: int,
                  top_p: float):
         import functools
@@ -1780,7 +1886,7 @@ class ServingState:
                     self.cfg, self.mesh, self.params,
                     max_new_tokens=max_new, temperature=temperature,
                     top_k=top_k, top_p=top_p, eos_id=self.eos_id,
-                    kv_quant=self.kv_quant,
+                    kv_quant=self.kv_quant, shard_batch=False,
                 )
                 return fn
 
@@ -1932,27 +2038,38 @@ class ServingState:
 
         from tpu_kubernetes.models.decode import prefill
 
-        pf = self._cached_program(
-            ("prefill", span),
-            lambda: jax.jit(functools.partial(
-                prefill, cfg=self.cfg, max_seq=span,
-                kv_quant=self.kv_quant,
-            )),
-        )
         rows = jnp.asarray(padded)
         lens = jnp.asarray(lengths, jnp.int32)
+        if self.mesh is not None:
+            # positional wrapper: explicit-sharding jit (kv_jit) does
+            # not take kwargs, and the example args must exist at build
+            def _pf(params, rows, lens):
+                return prefill(params, rows, self.cfg, max_seq=span,
+                               kv_quant=self.kv_quant, lengths=lens)
+
+            pf = self._model_program(
+                ("prefill", span), _pf, (self.params, rows, lens),
+            )
+            args, kwargs = (self.params, rows, lens), {}
+        else:
+            pf = self._cached_program(
+                ("prefill", span),
+                lambda: jax.jit(functools.partial(
+                    prefill, cfg=self.cfg, max_seq=span,
+                    kv_quant=self.kv_quant,
+                )),
+            )
+            args, kwargs = (self.params, rows), {"lengths": lens}
         # analytical roofline: capture the program's FLOPs/bytes before
         # its first call (lowering needs live concrete args)
         PROFILER.record_cost(
-            "prefill", pf, (self.params, rows), {"lengths": lens},
+            "prefill", pf, args, kwargs,
             tokens=int(rows.size), key=("prefill", span),
         )
         with PROFILER.phase(
             "prefill", key=("prefill", span), tracer=TRACER,
         ) as pp:
-            logits, cache = pp.sync(pf(
-                self.params, rows, lengths=lens,
-            ))
+            logits, cache = pp.sync(pf(*args, **kwargs))
         return logits, cache
 
     def _prefill_warm(self, ids: list, entry, q: int, width: int,
@@ -1970,21 +2087,32 @@ class ServingState:
 
         from tpu_kubernetes.models.decode import prefill_resume
 
-        rs = self._cached_program(
-            ("prefill_resume", span),
-            lambda: jax.jit(functools.partial(
-                prefill_resume, cfg=self.cfg,
-            )),
-        )
         base = self._expand_prefix(entry.arrays, q, span, b)
-        suffix = self._pad_rows([ids[q:]] * b, width - q)
+        suffix = jnp.asarray(self._pad_rows([ids[q:]] * b, width - q))
+        lens = jnp.asarray([len(ids) - q] * b, jnp.int32)
+        if self.mesh is not None:
+            def _rs(params, suffix, cache, lens):
+                return prefill_resume(params, suffix, self.cfg, cache,
+                                      lengths=lens)
+
+            rs = self._model_program(
+                ("prefill_resume", span), _rs,
+                (self.params, suffix, base, lens),
+            )
+            args, kwargs = (self.params, suffix, base, lens), {}
+        else:
+            rs = self._cached_program(
+                ("prefill_resume", span),
+                lambda: jax.jit(functools.partial(
+                    prefill_resume, cfg=self.cfg,
+                )),
+            )
+            args = (self.params, suffix)
+            kwargs = {"cache": base, "lengths": lens}
         with PROFILER.phase(
             "prefill_warm", key=("prefill_resume", span), tracer=TRACER,
         ) as pp:
-            logits, cache = pp.sync(rs(
-                self.params, jnp.asarray(suffix), cache=base,
-                lengths=jnp.asarray([len(ids) - q] * b, jnp.int32),
-            ))
+            logits, cache = pp.sync(rs(*args, **kwargs))
         return logits, cache
 
     def _prefill_any(self, ids: list, width: int, span: int, b: int = 1):
