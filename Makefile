@@ -3,7 +3,7 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast obs-check monitor-check perf-check goodput-check serve-identity-check serve-continuous-check paged-check sharded-check resilience-check bench dryrun native dist dist-offline clean
+.PHONY: test test-fast obs-check monitor-check flightrec-check perf-check goodput-check serve-identity-check serve-continuous-check paged-check sharded-check resilience-check bench dryrun native dist dist-offline clean
 
 test:
 	python -m pytest tests/ -q
@@ -17,11 +17,12 @@ test-fast:
 	python -m pytest tests/ -q -m "not slow"
 
 # Fast observability smoke: registry/events/tracer/exposition units, the
-# fleet aggregator + SLO suite, plus a live CPU server boot that scrapes
-# GET /metrics and walks /debug/trace (docs/guide/observability.md).
+# history store (tsdb), the fleet aggregator + SLO suite, plus a live
+# CPU server boot that scrapes GET /metrics and walks /debug/trace
+# (docs/guide/observability.md).
 obs-check:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py \
-	  tests/test_expfmt.py tests/test_fleet_obs.py \
+	  tests/test_expfmt.py tests/test_tsdb.py tests/test_fleet_obs.py \
 	  "tests/test_server.py::test_metrics_endpoint_prometheus_exposition" \
 	  "tests/test_server.py::test_healthz_reports_token_counters" \
 	  "tests/test_server.py::test_request_id_on_every_response" \
@@ -30,9 +31,24 @@ obs-check:
 
 # Fleet monitoring smoke: boots two in-process metrics servers, runs
 # `monitor --once --json` against both, and asserts one merged snapshot
-# with both instance labels (the ISSUE acceptance path).
+# with both instance labels, sparkline trend columns from the history
+# store, and the `get history` renderer (the ISSUE acceptance path).
 monitor-check:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_obs.py -q -m "not slow"
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_obs.py \
+	  tests/test_tsdb.py -q -m "not slow"
+
+# Flight-recorder gate: the recorder units (ring, atomic dumps,
+# retention, redaction, never-raises) plus the chaos matrix proving a
+# parseable, ledger- and page-consistent postmortem exists after every
+# serve-site fault and cold restart (docs/guide/observability.md
+# "Flight recorder").
+flightrec-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_flightrec.py \
+	  "tests/test_faults.py::test_flightrec_dump_after_chaos_at_every_site" \
+	  "tests/test_faults.py::test_flightrec_auto_dumps_on_engine_reset" \
+	  "tests/test_faults.py::test_flightrec_dumps_on_cold_restart" \
+	  "tests/test_faults.py::test_flightrec_http_endpoint_live" \
+	  -q -m "not slow"
 
 # Perf gate: the CPU-deterministic microbench suites (obs/perfbench.py)
 # checked against the committed baseline. The 5x threshold is deliberately

@@ -31,6 +31,19 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _flightrec_default_dir(tmp_path_factory):
+    """Serve-server fixtures that don't set TPU_K8S_FLIGHTREC_DIR fall back
+    to the recorder's CWD-relative default — which would litter the repo
+    with runs/flightrec/ dumps whenever an engine restarts mid-test."""
+    from tpu_kubernetes.obs import flightrec
+
+    old = flightrec.DEFAULT_DIR
+    flightrec.DEFAULT_DIR = str(tmp_path_factory.mktemp("flightrec-default"))
+    yield
+    flightrec.DEFAULT_DIR = old
+
+
 @pytest.fixture()
 def tk_home(tmp_path, monkeypatch):
     """Hermetic ~/.tpu-kubernetes root."""

@@ -143,3 +143,21 @@ def test_bucket_quantile_interpolation():
     # empty / all-zero histograms have no quantiles
     assert expfmt.bucket_quantile([], 0.9) is None
     assert expfmt.bucket_quantile([(1.0, 0.0), (math.inf, 0.0)], 0.9) is None
+
+
+def test_bucket_quantile_all_mass_in_inf_bucket():
+    """Regression: every observation above the largest finite bound used
+    to interpolate against +Inf and answer inf/NaN. The quantile clamps
+    to the largest finite bound instead — finite, plottable, honest
+    about the histogram's resolution."""
+    buckets = [(0.1, 0.0), (0.5, 0.0), (math.inf, 7.0)]
+    for q in (0.01, 0.5, 0.99):
+        got = expfmt.bucket_quantile(buckets, q)
+        assert got == 0.5
+        assert math.isfinite(got)
+
+
+def test_bucket_quantile_only_inf_bucket_is_none():
+    # a histogram with no finite bounds at all has nothing to clamp to
+    assert expfmt.bucket_quantile([(math.inf, 9.0)], 0.5) is None
+    assert expfmt.bucket_quantile([(math.inf, 0.0)], 0.5) is None

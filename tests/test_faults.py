@@ -448,3 +448,151 @@ def test_sharded_engine_restart_resets_pool_cold(sharded_chaos_server):
     """The watchdog-restart path on a mesh: the rebuilt pool is sharded
     again (device_put through the same kv shardings) and fully free."""
     _restart_resets_pool_cold(sharded_chaos_server.RequestHandlerClass.state)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder under chaos: a parseable black box after every fault
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def blackbox_chaos_server(tmp_path_factory):
+    """A paged continuous-batching server with the flight recorder
+    dumping into a per-module tmp dir (TPU_K8S_FLIGHTREC_DIR rides the
+    server env dict, not os.environ)."""
+    from tpu_kubernetes.serve.server import make_server
+
+    dump_dir = str(tmp_path_factory.mktemp("flightrec"))
+    srv = make_server(dict(
+        ENV, SERVER_HOST="127.0.0.1", SERVER_PORT="0",
+        SERVE_CONTINUOUS_BATCHING="1", SERVER_BATCH="2",
+        SERVE_PREFIX_CACHE_MB="4",
+        SERVE_KV_POOL_MB="0.25", SERVE_KV_PAGE_SIZE="16",
+        TPU_K8S_FLIGHTREC_DIR=dump_dir, TPU_K8S_FLIGHTREC_KEEP="64",
+    ))
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv, dump_dir
+    srv.shutdown()
+
+
+def _quiesce(state, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while (state._engine.stats()["occupied"]
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+
+
+def _load_dump(path):
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _dumps_with_reason(dump_dir, reason):
+    import os
+
+    return sorted(
+        os.path.join(dump_dir, n) for n in os.listdir(dump_dir)
+        if n.startswith("flightrec-") and reason in n and n.endswith(".json")
+    )
+
+
+def _last_pages_segment(payload):
+    for seg in reversed(payload.get("segments", [])):
+        if seg.get("pages"):
+            return seg["pages"]
+    return None
+
+
+@pytest.mark.parametrize("site", PAGED_SITES)
+def test_flightrec_dump_after_chaos_at_every_site(
+    blackbox_chaos_server, site,
+):
+    """Acceptance: after killing every serve site at prob 1.0 the
+    on-demand dump is present, parseable, and consistent — the embedded
+    ledger balances (classes + unsettled == emitted, unsettled back to
+    the pre-test floor) and the last recorded page partition sums to the
+    pool total."""
+    from tpu_kubernetes.obs.ledger import LEDGER
+
+    srv, dump_dir = blackbox_chaos_server
+    state = srv.RequestHandlerClass.state
+    assert state.flightrec is not None
+    floor = LEDGER.unsettled()
+    with injected(f"{site}:1.0:11"):
+        _fan_out_chaotic(state, PROMPTS)
+    # chaos over: drain with one clean request, wait for settlement
+    state.complete("pack my box", max_new_tokens=3)
+    deadline = time.time() + 10
+    while time.time() < deadline and LEDGER.unsettled() != floor:
+        time.sleep(0.02)
+    _quiesce(state)
+    _assert_pages_conserved(state)
+
+    path = state.flightrec.dump(f"chaos-{site}")
+    assert path is not None
+    payload = _load_dump(path)                       # parseable postmortem
+    assert payload["schema"].startswith("tpu-k8s-flightrec/")
+    assert payload["recorder"]["segments"] > 0
+    assert payload["faults_injected"].get(site, 0) > 0
+
+    ledger = payload["ledger"]
+    assert ledger["unsettled"] == floor
+    assert (sum(ledger["classes"].values()) + ledger["unsettled"]
+            == ledger["emitted"])
+
+    pages = _last_pages_segment(payload)
+    assert pages is not None
+    assert pages["free"] + pages["live"] + pages["pinned"] == pages["total"]
+
+
+def test_flightrec_auto_dumps_on_engine_reset(blackbox_chaos_server):
+    """A segment-site fault fails the engine out — the recorder must
+    have written an engine-reset postmortem on its own, carrying the
+    error string."""
+    srv, dump_dir = blackbox_chaos_server
+    state = srv.RequestHandlerClass.state
+    with injected("serve.segment:1.0:11"):
+        _fan_out_chaotic(state, PROMPTS)
+    state.complete("pack my box", max_new_tokens=3)  # engine recovered
+    dumps = _dumps_with_reason(dump_dir, "engine-reset")
+    assert dumps
+    payload = _load_dump(dumps[-1])
+    assert payload["reason"] == "engine-reset"
+    assert "error" in payload["extra"]
+    assert "injected fault" in payload["extra"]["error"]
+
+
+def test_flightrec_dumps_on_cold_restart(blackbox_chaos_server):
+    """The watchdog-restart path writes its own postmortem before the
+    reset wipes the engine state."""
+    srv, dump_dir = blackbox_chaos_server
+    state = srv.RequestHandlerClass.state
+    state.complete(PROMPTS[1], max_new_tokens=3)
+    _quiesce(state)
+    before = len(_dumps_with_reason(dump_dir, "watchdog-restart"))
+    state._engine.restart()
+    dumps = _dumps_with_reason(dump_dir, "watchdog-restart")
+    assert len(dumps) == before + 1
+    payload = _load_dump(dumps[-1])
+    assert payload["reason"] == "watchdog-restart"
+    # the count of restarts BEFORE this one — the dump happens first
+    assert payload["extra"]["restarts"] >= 0
+    # restarted engine serves immediately, black box still recording
+    assert state.complete("pack my box", max_new_tokens=3)["text"]
+
+
+def test_flightrec_http_endpoint_live(blackbox_chaos_server):
+    """GET /debug/flightrec returns the same payload without writing a
+    file, and the CLI renderer summarizes it."""
+    from tpu_kubernetes.obs.flightrec import fetch_flightrec, render_flightrec
+
+    srv, _dump_dir = blackbox_chaos_server
+    state = srv.RequestHandlerClass.state
+    state.complete(PROMPTS[0], max_new_tokens=3)
+    host, port = srv.server_address[:2]
+    payload = fetch_flightrec(f"{host}:{port}")
+    assert payload["reason"] == "on-demand"
+    assert payload["recorder"]["segments"] > 0
+    text = render_flightrec(payload)
+    assert "flight recorder" in text and "segments in ring" in text

@@ -19,7 +19,15 @@ from tpu_kubernetes.obs.aggregate import (
     rate,
 )
 from tpu_kubernetes.obs.metrics import Registry
-from tpu_kubernetes.obs.monitor import fleet_rows, render_table, snapshot_json
+from tpu_kubernetes.obs.monitor import (
+    SPARK_BINS,
+    fleet_rows,
+    render_table,
+    run_history,
+    run_monitor,
+    snapshot_json,
+)
+from tpu_kubernetes.obs.tsdb import SPARK_BARS, TSDB
 from tpu_kubernetes.obs.slo import (
     Alert,
     SLOTracker,
@@ -113,8 +121,28 @@ def test_normalize_target_forms():
 
 def test_rate_handles_resets_and_degenerate_windows():
     assert rate(110.0, 100.0, 5.0) == pytest.approx(2.0)
-    assert rate(5.0, 100.0, 5.0) is None      # counter reset
+    # counter reset (worker restarted): `then` is treated as 0, so the
+    # rate is the new value over the window — never negative, never None
+    assert rate(5.0, 100.0, 5.0) == pytest.approx(1.0)
     assert rate(1.0, 0.0, 0.0) is None
+
+
+def test_rate_clamps_after_engine_restart_mid_scrape_pair(two_workers):
+    """Regression: a worker restarting between two scrape cycles resets
+    its counters; the rate columns must clamp (reset detection), not go
+    negative or blank."""
+    a, b = two_workers
+    agg = FleetAggregator([a.target, b.target])
+    first = agg.scrape_once(now=1000.0)
+    # the "engine restart": the worker comes back with fresh counters,
+    # far below the previous cycle's cumulative readings
+    a.registry = _serving_registry(ok=2, tokens=20, inflight=0)
+    second = agg.scrape_once(now=1010.0)
+    rows = {r["instance"]: r for r in fleet_rows(second, prev=first)}
+    # 10 → 2 requests: delta -8 clamps to the post-restart value 2
+    assert rows[a.target]["rps"] == pytest.approx(0.2)
+    assert rows[a.target]["tokens_per_s"] == pytest.approx(2.0)
+    assert rows[b.target]["rps"] == pytest.approx(0.0)  # unaffected sibling
 
 
 # -- the aggregator against live workers -------------------------------------
@@ -431,3 +459,138 @@ def test_backoff_disabled_by_default(two_workers):
     h = agg.scrape_once(now=1000.1).health[dead]
     assert h.consecutive_failures == 2         # scraped both cycles
     assert h.backoff_s == 0.0 and h.next_scrape_ts == 0.0
+
+
+# -- history store: trend sparklines, --once rates, get history --------------
+
+
+_SPARK_CHARS = set(SPARK_BARS) | {"·"}
+
+
+def test_monitor_trends_with_store_and_dead_target_cycle(two_workers):
+    """Acceptance: monitor against two live workers grows sparkline trend
+    columns from the history store; a dead target degrades to up=0 while
+    the survivor keeps its trends."""
+    import io
+
+    a, b = two_workers
+    store = TSDB()
+    buf = io.StringIO()
+    assert run_monitor([a.target, b.target], interval=0.2, as_json=True,
+                       out=buf, max_cycles=2, store=store) == 0
+    snap = json.loads(buf.getvalue().strip().splitlines()[-1])
+    row = snap["instances"][a.target]
+    assert row["rps"] is not None              # store-backed, not two-point
+    assert set(row["spark"]) == {"rps", "p99_s", "goodput", "free_pages"}
+    for text in row["spark"].values():
+        assert len(text) == SPARK_BINS
+        assert set(text) <= _SPARK_CHARS
+    assert len(row["trend"]["rps"]) == SPARK_BINS
+
+    # human table: the trend columns appear once rows carry sparklines
+    buf2 = io.StringIO()
+    assert run_monitor([a.target, b.target], once=True, as_json=False,
+                       out=buf2, store=store) == 0
+    table = buf2.getvalue()
+    assert "~RPS" in table and "~GOODPUT" in table
+
+    b.stop()                                   # degradation cycle
+    buf3 = io.StringIO()
+    assert run_monitor([a.target, b.target], once=True, as_json=True,
+                       out=buf3, store=store) == 0
+    snap = json.loads(buf3.getvalue().strip().splitlines()[-1])
+    assert snap["instances"][b.target]["up"] == 0
+    survivor = snap["instances"][a.target]
+    assert survivor["up"] == 1
+    assert survivor["rps"] is not None
+    assert len(survivor["spark"]["rps"]) == SPARK_BINS
+
+
+def test_monitor_once_cold_store_shows_real_rates(two_workers):
+    """`monitor --once` used to print `-` for every rate (nothing to
+    diff against); now a cold store triggers one short-spaced second
+    scrape so rates are real numbers."""
+    import io
+
+    a, b = two_workers
+    buf = io.StringIO()
+    assert run_monitor([a.target, b.target], once=True, as_json=True,
+                       out=buf) == 0
+    snap = json.loads(buf.getvalue().strip().splitlines()[-1])
+    for instance in (a.target, b.target):
+        row = snap["instances"][instance]
+        assert row["rps"] is not None          # 0.0 here — but never null
+        assert row["tokens_per_s"] is not None
+
+
+def test_get_history_cli_json_and_dead_target(two_workers, capsys):
+    from tpu_kubernetes.cli.main import main
+
+    a, b = two_workers
+    argv = ["get", "history", "tpu_serve_tokens_generated_total",
+            "--targets", f"{a.target},{b.target}",
+            "--samples", "2", "--interval", "0.05", "--json"]
+    assert main(argv) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["metric"] == "tpu_serve_tokens_generated_total"
+    by_instance = {s["labels"]["instance"]: s for s in payload["series"]}
+    assert set(by_instance) == {a.target, b.target}
+    assert by_instance[a.target]["latest"] == 100.0
+    assert by_instance[b.target]["latest"] == 900.0
+    assert by_instance[a.target]["rate_per_s"] is not None
+    assert len(by_instance[a.target]["spark"]) == SPARK_BINS
+
+    b.stop()                                   # degradation: one target dead
+    assert main(argv) == 0                     # survivor still renders
+    payload = json.loads(capsys.readouterr().out)
+    instances = {s["labels"]["instance"] for s in payload["series"]}
+    assert a.target in instances
+
+    # a metric that never appears exits non-zero
+    assert main(["get", "history", "no_such_metric",
+                 "--targets", a.target, "--samples", "2",
+                 "--interval", "0.01", "--json"]) == 1
+
+
+def test_get_history_human_rendering(two_workers, capsys):
+    a, _b = two_workers
+    assert run_history("tpu_serve_inflight_requests", [a.target],
+                       samples=2, interval=0.05) == 0
+    out = capsys.readouterr().out
+    assert "tpu_serve_inflight_requests" in out
+    assert "latest=" in out and "rate/s=" in out
+
+
+def test_alert_json_carries_since_age_and_burn_thresholds(two_workers):
+    """Satellite: `monitor --json` alert objects say how long the alert
+    has been active and what burn multiple the thresholds demand."""
+    from tpu_kubernetes.obs.slo import FAST_BURN, SLOW_BURN
+
+    a, b = two_workers
+    req = a.registry.counter(
+        "tpu_serve_requests_total", "requests",
+        labelnames=("endpoint", "code"),
+    )
+    agg = FleetAggregator([a.target, b.target])
+    slo = SLOTracker("availability", 0.999, availability_source, for_s=60.0)
+    t0 = 1_000_000.0
+
+    def cycle(now):
+        snap = agg.scrape_once(now=now)
+        slo.observe(snap, now=now)
+        return slo.evaluate(now=now)
+
+    req.labels("/v1/completions", "200").inc(1000)
+    d = cycle(t0).to_dict()
+    assert d["since"] is None and d["age_s"] is None
+
+    req.labels("/v1/completions", "500").inc(100)
+    d = cycle(t0 + 60).to_dict()
+    assert d["state"] == "pending"
+    assert d["since"] == t0 + 60 and d["age_s"] == 0.0
+    assert d["burn_fast"] >= d["burn_fast_threshold"] == FAST_BURN
+    assert d["burn_slow_threshold"] == SLOW_BURN
+
+    d = cycle(t0 + 120).to_dict()
+    assert d["state"] == "firing"
+    assert d["age_s"] == pytest.approx(60.0)

@@ -1,0 +1,170 @@
+"""The serve-engine flight recorder (obs/flightrec.py): bounded segment
+ring, atomic dumps with runs/-style retention, user-content redaction,
+and the never-raises operational stance."""
+
+import json
+import os
+import time
+
+from tpu_kubernetes.obs.flightrec import (
+    DEFAULT_KEEP,
+    DEFAULT_SEGMENTS,
+    SCHEMA,
+    FlightRecorder,
+    redact,
+    render_flightrec,
+)
+from tpu_kubernetes.obs.metrics import Registry
+
+
+def _recorder(tmp_path, **kw):
+    kw.setdefault("registry", Registry())
+    return FlightRecorder(directory=str(tmp_path / "flightrec"), **kw)
+
+
+# -- the segment ring --------------------------------------------------------
+
+
+def test_segment_ring_is_bounded(tmp_path):
+    rec = _recorder(tmp_path, capacity=4)
+    for i in range(10):
+        rec.record_segment(steps=i, occupied=1, slots=2)
+    snap = rec.snapshot()
+    assert len(snap["segments"]) == 4                  # ring holds newest 4
+    assert [s["steps"] for s in snap["segments"]] == [6, 7, 8, 9]
+    assert snap["recorder"]["segments"] == 10          # but counts them all
+    assert all("ts" in s for s in snap["segments"])
+
+
+def test_snapshot_shape_and_extra(tmp_path):
+    reg = Registry()
+    reg.counter("tpu_serve_requests_total", "req",
+                labelnames=("endpoint", "code")).labels("/x", "200").inc(5)
+    rec = _recorder(tmp_path, registry=reg)
+    rec.record_segment(steps=1)
+    snap = rec.snapshot(reason="unit-test", extra={"trigger": "manual"})
+    assert snap["schema"] == SCHEMA
+    assert snap["reason"] == "unit-test"
+    assert snap["pid"] == os.getpid()
+    assert snap["extra"] == {"trigger": "manual"}
+    for key in ("recorder", "segments", "ledger", "alerts",
+                "faults_injected", "spans", "history"):
+        assert key in snap
+    # the forced observe pulled the registry into the history store
+    hist = snap["history"]["tpu_serve_requests_total"]
+    assert hist[0]["samples"][-1][1] == 5.0
+    json.dumps(snap)                                   # JSON-clean whole
+
+
+# -- dumps: atomic write, retention, never-raises ----------------------------
+
+
+def test_dump_writes_parseable_json_and_prunes(tmp_path):
+    rec = _recorder(tmp_path, keep=3)
+    rec.record_segment(steps=1, occupied=2, slots=4)
+    paths = []
+    for i in range(5):
+        p = rec.dump("engine-reset", extra={"round": i})
+        assert p is not None
+        paths.append(p)
+        time.sleep(0.002)          # distinct millisecond filenames
+    kept = sorted(os.listdir(rec.directory))
+    assert len(kept) == 3                              # pruned to keep=3
+    assert all(n.startswith("flightrec-") and n.endswith(".json")
+               for n in kept)                          # no tmp leftovers
+    assert [os.path.basename(p) for p in paths[-3:]] == kept
+    with open(paths[-1], encoding="utf-8") as f:
+        payload = json.load(f)
+    assert payload["schema"] == SCHEMA
+    assert payload["reason"] == "engine-reset"
+    assert payload["extra"] == {"round": 4}
+    assert rec.snapshot()["recorder"]["dumps"] == 5
+
+
+def test_dump_reason_is_filename_safe(tmp_path):
+    rec = _recorder(tmp_path)
+    p = rec.dump("weird reason/../../etc")
+    assert p is not None
+    assert os.path.dirname(p) == rec.directory         # no traversal
+    assert "/.." not in os.path.basename(p)
+
+
+def test_dump_never_raises_on_unwritable_dir():
+    rec = FlightRecorder(directory="/proc/definitely/not/writable",
+                         registry=Registry())
+    assert rec.dump("hard-fail") is None               # swallowed, reported
+    assert rec.snapshot()["recorder"]["dump_failures"] == 1
+
+
+def test_record_segment_never_raises(tmp_path):
+    rec = _recorder(tmp_path)
+
+    class Boom:
+        def __deepcopy__(self, *a):
+            raise RuntimeError("no")
+
+    rec.record_segment(steps=1, weird=Boom())          # must not raise
+    assert rec.snapshot()["recorder"]["segments"] >= 1
+
+
+# -- redaction ---------------------------------------------------------------
+
+
+def test_redact_strips_user_content_keys():
+    payload = {
+        "prompt": "the secret user prompt",
+        "nested": {"messages": ["hi", "there"], "steps": 3},
+        "token_ids": [1, 2, 3],
+        "note": "x" * 600,
+    }
+    out = redact(payload)
+    assert out["prompt"] == "<redacted:22>"
+    assert out["nested"]["messages"] == "<redacted:2>"
+    assert out["nested"]["steps"] == 3                 # telemetry untouched
+    assert out["token_ids"] == "<redacted:3>"
+    assert len(out["note"]) < 600 and "truncated" in out["note"]
+
+
+def test_dump_payload_is_redacted_end_to_end(tmp_path):
+    rec = _recorder(tmp_path)
+    rec.record_segment(steps=1, prompt="leak me")
+    p = rec.dump("drain")
+    with open(p, encoding="utf-8") as f:
+        text = f.read()
+    assert "leak me" not in text
+    assert "<redacted:7>" in text
+
+
+# -- configuration -----------------------------------------------------------
+
+
+def test_from_env_reads_the_server_env_dict(tmp_path):
+    rec = FlightRecorder.from_env({
+        "TPU_K8S_FLIGHTREC_DIR": str(tmp_path / "bb"),
+        "TPU_K8S_FLIGHTREC_KEEP": "2",
+        "TPU_K8S_FLIGHTREC_SEGMENTS": "16",
+    })
+    assert rec.directory == str(tmp_path / "bb")
+    assert rec.keep == 2
+    assert rec._segments.maxlen == 16
+
+    defaults = FlightRecorder.from_env({
+        "TPU_K8S_FLIGHTREC_KEEP": "not-a-number",
+    })
+    assert defaults.keep == DEFAULT_KEEP
+    assert defaults._segments.maxlen == DEFAULT_SEGMENTS
+
+
+# -- operator rendering ------------------------------------------------------
+
+
+def test_render_flightrec_summarizes(tmp_path):
+    rec = _recorder(tmp_path)
+    rec.record_segment(steps=3, occupied=2, slots=4, live_steps=5,
+                       admitted=1, reaped=0, queued=2,
+                       pages={"free": 10, "live": 5, "pinned": 1,
+                              "total": 16, "stalls": 0})
+    text = render_flightrec(rec.snapshot())
+    assert "flight recorder" in text
+    assert "occupied 2/4" in text
+    assert "free=10" in text and "total=16" in text

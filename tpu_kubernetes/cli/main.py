@@ -76,18 +76,28 @@ def build_parser() -> argparse.ArgumentParser:
         "get",
         help="query a manager or cluster, fetch a kubeconfig, list "
              "recorded workflow runs, dump in-process metrics, render "
-             "a serving worker's phase-profile breakdown, or its "
-             "goodput ledger",
+             "a serving worker's phase-profile breakdown, its goodput "
+             "ledger, its flight-recorder black box, or a metric's "
+             "scraped history",
     )
     get.add_argument(
         "kind",
         choices=["manager", "cluster", "kubeconfig", "runs", "metrics",
-                 "profile", "goodput"],
+                 "profile", "goodput", "history", "flightrec"],
         help="profile renders the worker's phase table — cold (prefill) "
              "vs warm (prefill_warm) prefills split out, so prefix-cache "
              "savings are read off one row pair; goodput renders the "
              "token ledger (useful/cancelled/expired/shed-spent/bubble), "
-             "slot-engine bubble fraction, and analytical MFU/roofline",
+             "slot-engine bubble fraction, and analytical MFU/roofline; "
+             "history scrapes a metric over a few spaced cycles and "
+             "renders per-series latest/rate/min/max + a sparkline; "
+             "flightrec renders the engine's live black box "
+             "(GET /debug/flightrec)",
+    )
+    get.add_argument(
+        "metric", nargs="?", metavar="METRIC",
+        help="with history: the metric family to query "
+             "(e.g. tpu_serve_requests_total)",
     )
     get.add_argument(
         "--manager", metavar="NAME",
@@ -95,13 +105,33 @@ def build_parser() -> argparse.ArgumentParser:
     )
     get.add_argument(
         "--json", dest="as_json", action="store_true",
-        help="with runs/profile/goodput: dump the raw JSON instead of "
-             "the table",
+        help="with runs/profile/goodput/history/flightrec: dump the raw "
+             "JSON instead of the table",
     )
     get.add_argument(
         "--target", metavar="HOST:PORT", default="127.0.0.1:8000",
-        help="with profile/goodput: the serving worker to query "
-             "(default 127.0.0.1:8000)",
+        help="with profile/goodput/flightrec: the serving worker to "
+             "query (default 127.0.0.1:8000)",
+    )
+    get.add_argument(
+        "--targets", metavar="HOST:PORT[,HOST:PORT...]", default=None,
+        help="with history: comma-separated worker endpoints to scrape "
+             "(default: the --target value)",
+    )
+    get.add_argument(
+        "--window", type=float, default=60.0, metavar="SECONDS",
+        help="with history: the trailing window rates/sparklines cover "
+             "(default 60)",
+    )
+    get.add_argument(
+        "--samples", type=int, default=5, metavar="N",
+        help="with history: scrape cycles to take before rendering "
+             "(default 5)",
+    )
+    get.add_argument(
+        "--interval", type=float, default=1.0, metavar="SECONDS",
+        help="with history: seconds between the scrape cycles "
+             "(default 1)",
     )
 
     repair = sub.add_parser(
@@ -148,7 +178,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     monitor.add_argument(
         "--once", action="store_true",
-        help="one scrape cycle, then exit (scripting/smoke checks)",
+        help="one scrape cycle, then exit (scripting/smoke checks); a "
+             "cold start takes one short-spaced second scrape so the "
+             "rate columns are real, not '-'",
+    )
+    monitor.add_argument(
+        "--window", type=float, default=60.0, metavar="SECONDS",
+        help="trailing window the rate and sparkline trend columns "
+             "cover (default 60)",
     )
 
     bench = sub.add_parser(
@@ -231,8 +268,49 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         return run_monitor(
             targets, interval=args.interval, once=args.once,
+            as_json=args.as_json, window=args.window,
+        )
+
+    if args.command == "get" and args.kind == "history":
+        # scrape-and-render a metric's recent history (obs/monitor.py +
+        # obs/tsdb.py) — no backend, config, or prompts involved
+        from tpu_kubernetes.obs.monitor import run_history
+
+        if not args.metric:
+            print("error: get history needs a metric name "
+                  "(e.g. tpu_serve_requests_total)", file=sys.stderr)
+            return 2
+        raw = args.targets if args.targets else args.target
+        targets = [t.strip() for t in raw.split(",") if t.strip()]
+        if not targets:
+            print("error: get history needs at least one target",
+                  file=sys.stderr)
+            return 2
+        return run_history(
+            args.metric, targets, window=args.window,
+            samples=args.samples, interval=args.interval,
             as_json=args.as_json,
         )
+
+    if args.command == "get" and args.kind == "flightrec":
+        # a remote worker's GET /debug/flightrec, rendered — same
+        # stance as get profile/goodput
+        from tpu_kubernetes.obs.flightrec import (
+            fetch_flightrec,
+            render_flightrec,
+        )
+
+        try:
+            data = fetch_flightrec(args.target)
+        except Exception as e:  # noqa: BLE001 — network errors → exit 1
+            print(f"error: cannot fetch flight recorder from "
+                  f"{args.target}: {e}", file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps(data, indent=2, sort_keys=True))
+        else:
+            print(render_flightrec(data), end="")
+        return 0
 
     if args.command == "bench":
         # microbenches need jax, not a backend/config — short-circuit

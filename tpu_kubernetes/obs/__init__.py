@@ -19,10 +19,19 @@ single-process path):
 * :mod:`tpu_kubernetes.obs.aggregate` — concurrent multi-target
   ``/metrics`` scraper merging workers into one fleet snapshot with
   ``instance`` labels and per-target ``up`` health.
+* :mod:`tpu_kubernetes.obs.tsdb` — bounded in-memory time-series store
+  (raw → 10s → 1m downsample tiers under a hard memory cap) with
+  reset-aware ``rate/avg/max/quantile_over_time`` — the retained
+  history every fleet scrape feeds and the SLO burn windows read.
 * :mod:`tpu_kubernetes.obs.slo` — sliding-window SLOs with
-  multi-window burn-rate alerting over fleet snapshots.
+  multi-window burn-rate alerting, windows read from the tsdb store.
 * :mod:`tpu_kubernetes.obs.monitor` — the ``tpu-kubernetes monitor``
-  fleet table / JSON renderer.
+  fleet table / JSON renderer with sparkline trend columns, plus the
+  ``get history`` renderer.
+* :mod:`tpu_kubernetes.obs.flightrec` — the serve engine's flight
+  recorder: per-segment snapshot ring + redacted JSON postmortems
+  dumped atomically on restart/hard-fail/drain (``GET
+  /debug/flightrec``, ``get flightrec``).
 
 Performance attribution (also lazy — profile needs no jax at import,
 perfbench imports jax only when benches run):

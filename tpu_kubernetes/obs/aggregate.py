@@ -166,7 +166,7 @@ class FleetAggregator:
 
     def __init__(self, targets: list[str], timeout_s: float = 2.0,
                  retries: int = 1, max_workers: int = 16,
-                 backoff_base_s: float = 0.0):
+                 backoff_base_s: float = 0.0, tsdb=None):
         self._targets = [_normalize_target(t) for t in targets]
         if not self._targets:
             raise ValueError("FleetAggregator needs at least one target")
@@ -178,6 +178,10 @@ class FleetAggregator:
         # base, reset on success. 0 keeps every cycle scraping every
         # target (one-shot callers want the immediate answer).
         self.backoff_base_s = max(0.0, float(backoff_base_s))
+        # a history store (obs/tsdb.py, duck-typed: .ingest(snapshot))
+        # receives every merged cycle — the one source of truth the SLO
+        # burn windows, monitor trends, and `get history` all read
+        self._tsdb = tsdb
         self._max_workers = max(1, min(max_workers, len(self._targets)))
         self._lock = threading.Lock()
         self._health: dict[str, TargetHealth] = {
@@ -300,15 +304,24 @@ class FleetAggregator:
                     for i in sorted(health)
                 ],
             )
-        return FleetSnapshot(ts=now, health=health, families=merged)
+        snapshot = FleetSnapshot(ts=now, health=health, families=merged)
+        if self._tsdb is not None:
+            try:
+                self._tsdb.ingest(snapshot)
+            except Exception:  # noqa: BLE001 — history must not fail a scrape
+                pass
+        return snapshot
 
 
 def rate(now_value: float, then_value: float, seconds: float) -> float | None:
     """Per-second rate between two cumulative readings; None when the
-    elapsed window is degenerate or a counter reset went backwards."""
+    elapsed window is degenerate. A negative delta means the counter
+    reset (worker restarted between cycles) — Prometheus semantics treat
+    ``then`` as 0, so the rate is the new value over the window rather
+    than a negative or a blank."""
     if seconds <= 0 or not math.isfinite(seconds):
         return None
     delta = now_value - then_value
-    if delta < 0:  # worker restarted between cycles
-        return None
+    if delta < 0:  # counter reset: everything since restart is increase
+        delta = now_value
     return delta / seconds

@@ -222,7 +222,9 @@ def bucket_quantile(buckets: list[tuple[float, float]],
     """Estimate quantile ``q`` from cumulative ``(le, count)`` pairs —
     Prometheus ``histogram_quantile`` semantics: linear interpolation
     inside the bucket holding the rank; the ``+Inf`` bucket answers with
-    the highest finite bound. None when the histogram is empty."""
+    the highest finite bound (never inf/NaN). None when the histogram is
+    empty or only a ``+Inf`` bucket exists — with no finite bound at all
+    there is no honest estimate to return."""
     if not buckets:
         return None
     buckets = sorted(buckets)
@@ -231,12 +233,14 @@ def bucket_quantile(buckets: list[tuple[float, float]],
         return None
     rank = q * total
     prev_le, prev_n = 0.0, 0.0
+    seen_finite = False
     for le, n in buckets:
         if n >= rank:
             if math.isinf(le):
-                return prev_le
+                return prev_le if seen_finite else None
             if n == prev_n:
                 return le
             return prev_le + (le - prev_le) * ((rank - prev_n) / (n - prev_n))
         prev_le, prev_n = le, n
-    return prev_le
+        seen_finite = seen_finite or not math.isinf(le)
+    return prev_le if seen_finite else None

@@ -1,16 +1,24 @@
 """The ``tpu-kubernetes monitor`` loop: fleet table + firing SLO alerts.
 
 Ties the fleet layer together for an operator terminal: poll the
-aggregator (obs/aggregate.py), feed the SLO trackers (obs/slo.py), and
-render one line per worker — RPS, latency quantiles, TTFT, tokens/sec,
-in-flight queue depth, and ``up`` — plus whatever alerts are pending or
-firing. ``--json`` emits the same snapshot as one JSON object per cycle
-(what scripts and the acceptance tests consume); ``--once`` does a
-single cycle and exits.
+aggregator (obs/aggregate.py), feed every scrape into the history store
+(obs/tsdb.py) and the SLO trackers (obs/slo.py, burn windows read from
+the same store), and render one line per worker — RPS, latency
+quantiles, TTFT, tokens/sec, in-flight queue depth, ``up`` — plus
+unicode sparkline trend columns (RPS, p99, goodput, free KV pages) over
+``--window`` seconds and whatever alerts are pending or firing.
+``--json`` emits the same snapshot as one JSON object per cycle (what
+scripts and the acceptance tests consume); ``--once`` does a single
+cycle and exits.
 
-Rates (RPS, tokens/sec) are deltas between consecutive cycles, so the
-first cycle — and every ``--once`` run — shows ``-`` for them; quantiles
-come from the cumulative histograms (since worker start).
+Rates come from the history store. A ``--once`` run that starts with an
+empty store takes one short-spaced second scrape so even one-shot
+invocations show real RPS/tokens-per-sec instead of ``-``; a store that
+already has samples (a long-lived caller, tests) answers immediately.
+
+``run_history`` backs the ``get history <metric>`` CLI: a few spaced
+scrapes into a fresh store, then per-series latest/rate/min/max plus a
+sparkline — the trends a fleet controller will scale on, on demand.
 """
 
 from __future__ import annotations
@@ -22,6 +30,7 @@ from typing import Any, Callable, TextIO
 
 from tpu_kubernetes.obs.aggregate import FleetAggregator, FleetSnapshot, rate
 from tpu_kubernetes.obs.slo import Alert, SLOTracker, default_slos
+from tpu_kubernetes.obs.tsdb import TSDB, sparkline
 
 REQUESTS = "tpu_serve_requests_total"
 LATENCY = "tpu_serve_request_seconds"
@@ -30,17 +39,60 @@ TOKENS = "tpu_serve_tokens_generated_total"
 TOKENS_CLASS = "tpu_serve_tokens_total"
 TOKENS_EMITTED = "tpu_serve_tokens_emitted_total"
 INFLIGHT = "tpu_serve_inflight_requests"
+KV_FREE_PAGES = "tpu_serve_kv_pages"
 BUILD_INFO = "tpu_k8s_build_info"
+
+# how many slots each sparkline column renders (one char per slot)
+SPARK_BINS = 8
+# the gap before a --once second scrape when the store starts empty —
+# long enough for real counter deltas, short enough for an interactive
+# one-shot
+ONCE_RESCRAPE_GAP_S = 0.5
 
 
 def _of_instance(instance: str) -> Callable[[dict[str, str]], bool]:
     return lambda labels: labels.get("instance") == instance
 
 
+def _trend(store: TSDB, instance: str, window: float, now: float,
+           ) -> dict[str, list[float | None]]:
+    """The per-instance sparkline feeds, oldest bin first."""
+    mine = _of_instance(instance)
+    bins = SPARK_BINS
+    # p99 per bin: the windowed quantile evaluated at each bin's right
+    # edge over a bin-sized sub-window
+    width = window / bins
+    p99: list[float | None] = []
+    for i in range(bins):
+        edge = now - window + (i + 1) * width
+        p99.append(store.quantile_over_time(LATENCY, 0.99, width, edge, mine))
+    emitted = store.binned(TOKENS_EMITTED, window, now, bins, "rate", mine)
+    useful = store.binned(
+        TOKENS_CLASS, window, now, bins, "rate",
+        lambda labels: (labels.get("instance") == instance
+                        and labels.get("class") == "useful"),
+    )
+    goodput: list[float | None] = [
+        (u / e) if (u is not None and e not in (None, 0.0)) else None
+        for u, e in zip(useful, emitted)
+    ]
+    return {
+        "rps": store.binned(REQUESTS, window, now, bins, "rate", mine),
+        "p99_s": p99,
+        "goodput": goodput,
+        "free_pages": store.binned(KV_FREE_PAGES, window, now, bins,
+                                   "value", mine),
+    }
+
+
 def fleet_rows(snapshot: FleetSnapshot,
-               prev: FleetSnapshot | None = None) -> list[dict[str, Any]]:
-    """Per-instance stats rows. ``prev`` (the previous cycle's snapshot)
-    enables the rate columns; without it they are None."""
+               prev: FleetSnapshot | None = None,
+               store: TSDB | None = None,
+               window: float = 60.0) -> list[dict[str, Any]]:
+    """Per-instance stats rows. With a history ``store`` the rate and
+    trend columns come from it (reset-aware, any number of retained
+    cycles); ``prev`` (the previous cycle's snapshot) is the fallback
+    two-point rate for store-less callers."""
     rows = []
     dt = snapshot.ts - prev.ts if prev is not None else 0.0
     for instance in snapshot.instances():
@@ -76,7 +128,17 @@ def fleet_rows(snapshot: FleetSnapshot,
             "queue_depth": snapshot.value_sum(INFLIGHT, mine),
             "goodput": round(useful / emitted, 4) if emitted else None,
         }
-        if prev is not None and instance in prev.health:
+        if store is not None:
+            row["rps"] = store.rate_over_time(
+                REQUESTS, window, snapshot.ts, mine
+            )
+            row["tokens_per_s"] = store.rate_over_time(
+                TOKENS, window, snapshot.ts, mine
+            )
+            trend = _trend(store, instance, window, snapshot.ts)
+            row["trend"] = trend
+            row["spark"] = {k: sparkline(v) for k, v in trend.items()}
+        elif prev is not None and instance in prev.health:
             row["rps"] = rate(
                 requests, prev.value_sum(REQUESTS, mine), dt
             )
@@ -99,12 +161,18 @@ def _fmt(value: Any, unit: str = "", width: int = 8) -> str:
 
 def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
                  ts: float | None = None) -> str:
-    """The human rendering: one aligned row per instance, then any
-    pending/firing alerts."""
+    """The human rendering: one aligned row per instance (trend columns
+    when the rows carry history sparklines), then any pending/firing
+    alerts."""
+    with_trends = any("spark" in row for row in rows)
     header = (
         f"{'INSTANCE':<24} {'UP':>2} {'VER':>8} {'RPS':>8} {'P50':>8} "
         f"{'P99':>8} {'TTFT99':>8} {'TOK/S':>8} {'QUEUE':>6} {'GOODPUT':>8}"
     )
+    if with_trends:
+        header += (
+            f"  {'~RPS':<8} {'~P99':<8} {'~GOODPUT':<8} {'~FREEPG':<8}"
+        )
     lines = []
     if ts is not None:
         lines.append(time.strftime(
@@ -112,7 +180,7 @@ def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
         ))
     lines.append(header)
     for row in rows:
-        lines.append(
+        line = (
             f"{row['instance']:<24} {row['up']:>2}"
             f" {(row.get('version') or '-'):>8}"
             f"{_fmt(row['rps'])}"
@@ -123,6 +191,14 @@ def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
             f"{_fmt(int(row['queue_depth']), '', 7)}"
             f"{_fmt(row.get('goodput'), '', 9)}"
         )
+        if with_trends:
+            spark = row.get("spark", {})
+            line += (
+                f"  {spark.get('rps', ''):<8} {spark.get('p99_s', ''):<8}"
+                f" {spark.get('goodput', ''):<8}"
+                f" {spark.get('free_pages', ''):<8}"
+            )
+        lines.append(line)
         if not row["up"] and row["error"]:
             lines.append(
                 f"  └─ down ({row['consecutive_failures']} consecutive): "
@@ -133,9 +209,10 @@ def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
         lines.append("")
         lines.append("ALERTS")
         for a in active:
+            age = f" for {a.age_s:.0f}s" if a.age_s is not None else ""
             lines.append(
                 f"  [{a.state.upper():>7}] {a.slo} (target {a.target:.3%})"
-                f" burn fast={a.burn_fast:.1f}x slow={a.burn_slow:.1f}x"
+                f" burn fast={a.burn_fast:.1f}x slow={a.burn_slow:.1f}x{age}"
                 f"{' — ' + a.description if a.description else ''}"
             )
     return "\n".join(lines) + "\n"
@@ -156,36 +233,123 @@ def run_monitor(targets: list[str], interval: float = 5.0,
                 out: TextIO | None = None,
                 slos: list[SLOTracker] | None = None,
                 max_cycles: int | None = None,
-                timeout_s: float = 2.0) -> int:
-    """The CLI loop. Returns the process exit code."""
+                timeout_s: float = 2.0,
+                window: float = 60.0,
+                store: TSDB | None = None) -> int:
+    """The CLI loop. Returns the process exit code. ``store`` lets a
+    caller pre-seed (or retain) fleet history across invocations; by
+    default each run owns a fresh one."""
     out = sys.stdout if out is None else out
+    store = TSDB() if store is None else store
     # the poll interval doubles as the backoff base: a dead target falls
     # back to ~8x interval re-polls instead of burning a timeout per
     # cycle forever (one-shot runs keep every target in the cycle)
     aggregator = FleetAggregator(
         targets, timeout_s=timeout_s,
         backoff_base_s=0.0 if once else interval,
+        tsdb=store,
     )
-    trackers = default_slos() if slos is None else slos
-    prev: FleetSnapshot | None = None
+    trackers = default_slos(store=store) if slos is None else slos
     cycles = 0
     try:
         while True:
             snapshot = aggregator.scrape_once()
+            if once and cycles == 0:
+                # one-shot runs against a cold store can't answer rates
+                # (one point per counter) — a second short-spaced scrape
+                # seeds real deltas; a pre-seeded store (a long-lived
+                # caller handed history in) answers immediately
+                needs_seed = store.has_samples(REQUESTS) and all(
+                    len(samples) < 2
+                    for _, samples in store.window(
+                        REQUESTS, snapshot.ts - window, snapshot.ts
+                    )
+                )
+                if needs_seed:
+                    time.sleep(ONCE_RESCRAPE_GAP_S)
+                    snapshot = aggregator.scrape_once()
             for tracker in trackers:
                 tracker.observe(snapshot, now=snapshot.ts)
             alerts = [t.evaluate(now=snapshot.ts) for t in trackers]
-            rows = fleet_rows(snapshot, prev)
+            rows = fleet_rows(snapshot, store=store, window=window)
             if as_json:
                 print(json.dumps(snapshot_json(snapshot, rows, alerts),
                                  sort_keys=True), file=out, flush=True)
             else:
                 print(render_table(rows, alerts, ts=snapshot.ts),
                       file=out, flush=True)
-            prev = snapshot
             cycles += 1
             if once or (max_cycles is not None and cycles >= max_cycles):
                 return 0
             time.sleep(interval)
     except KeyboardInterrupt:
         return 0
+
+
+def run_history(metric: str, targets: list[str], window: float = 60.0,
+                samples: int = 5, interval: float = 1.0,
+                as_json: bool = False, out: TextIO | None = None,
+                timeout_s: float = 2.0,
+                store: TSDB | None = None) -> int:
+    """``get history <metric>``: scrape a few spaced cycles into a
+    history store (or query one handed in), then render every series of
+    the metric — latest, per-second rate (counters), min/max, sparkline.
+    Exit 1 when the metric never appeared (typo or all targets down)."""
+    out = sys.stdout if out is None else out
+    scraped_here = store is None
+    store = TSDB() if store is None else store
+    aggregator = FleetAggregator(targets, timeout_s=timeout_s, tsdb=store)
+    cycles = max(2, int(samples)) if scraped_here else max(1, int(samples))
+    for i in range(cycles):
+        snapshot = aggregator.scrape_once()
+        if i < cycles - 1:
+            time.sleep(max(0.0, interval))
+    now = snapshot.ts
+    series = store.window(metric, now - window, now)
+    payload = {
+        "metric": metric,
+        "window_s": window,
+        "ts": now,
+        "series": [],
+    }
+    for labels, points in sorted(series, key=lambda kv: sorted(kv[0].items())):
+        mine = (lambda want: lambda have: all(
+            have.get(k) == v for k, v in want.items()
+        ))(labels)
+        vals = [v for _, v in points]
+        entry = {
+            "labels": labels,
+            "latest": vals[-1] if vals else None,
+            "min": min(vals) if vals else None,
+            "max": max(vals) if vals else None,
+            "rate_per_s": store.rate_over_time(metric, window, now, mine),
+            "spark": sparkline(
+                store.binned(metric, window, now, SPARK_BINS, "rate", mine)
+                if len(points) >= 2 else
+                store.binned(metric, window, now, SPARK_BINS, "value", mine)
+            ),
+            "samples": [[round(t, 3), v] for t, v in points],
+        }
+        payload["series"].append(entry)
+    if as_json:
+        print(json.dumps(payload, sort_keys=True), file=out, flush=True)
+    elif not payload["series"]:
+        print(f"no samples for {metric!r} (targets down or unknown metric; "
+              f"try `get metrics` for names)", file=out, flush=True)
+    else:
+        print(f"{metric} over the last {window:g}s "
+              f"({len(payload['series'])} series)", file=out, flush=True)
+        for entry in payload["series"]:
+            labels = ",".join(
+                f"{k}={v}" for k, v in sorted(entry["labels"].items())
+            )
+            print(
+                f"  {{{labels}}}"
+                f" latest={_fmt(entry['latest']).strip()}"
+                f" rate/s={_fmt(entry['rate_per_s']).strip()}"
+                f" min={_fmt(entry['min']).strip()}"
+                f" max={_fmt(entry['max']).strip()}"
+                f"  {entry['spark']}",
+                file=out, flush=True,
+            )
+    return 0 if payload["series"] else 1

@@ -4,9 +4,11 @@ The fleet aggregator (obs/aggregate.py) answers "what are the numbers";
 this module answers "is the fleet keeping its promises". Each
 :class:`SLOTracker` reduces a :class:`~tpu_kubernetes.obs.aggregate.
 FleetSnapshot` to a good/total event pair (availability from status
-codes, latency and TTFT from histogram buckets vs a threshold), keeps a
-bounded history of readings, and evaluates the multi-window burn-rate
-rule from the SRE workbook:
+codes, latency and TTFT from histogram buckets vs a threshold), records
+the pair as counter series in a history store (obs/tsdb.py — the same
+store the monitor trends and ``get history`` read, one source of
+truth), and evaluates the multi-window burn-rate rule from the SRE
+workbook:
 
 * **fast** — burn ≥ 14.4× over BOTH the 5m and 1h windows (budget gone
   in hours → page);
@@ -27,17 +29,23 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from dataclasses import dataclass
 from typing import Callable
 
 from tpu_kubernetes.obs.aggregate import FleetSnapshot
+from tpu_kubernetes.obs.tsdb import TSDB
 
 # (windows that must BOTH breach, burn multiple, severity)
 FAST_WINDOWS = (300.0, 3600.0)
 FAST_BURN = 14.4
 SLOW_WINDOWS = (1800.0, 21600.0)
 SLOW_BURN = 6.0
+
+# the series an objective writes into its history store — one pair per
+# tracker, distinguished by the ``slo`` label, so many objectives can
+# share one store with the fleet scrape series
+GOOD_SERIES = "slo_good_total"
+TOTAL_SERIES = "slo_events_total"
 
 OK = "ok"
 PENDING = "pending"
@@ -53,38 +61,44 @@ class Alert:
     target: float
     severity: str = ""            # "page" (fast) / "ticket" (slow) when breaching
     since: float | None = None    # when the current pending/firing began
+    age_s: float | None = None    # seconds in the current pending/firing state
     burn_fast: float = 0.0        # min burn over the fast window pair
     burn_slow: float = 0.0        # min burn over the slow window pair
     description: str = ""
 
     def to_dict(self) -> dict:
+        """The pager-facing shape: ``since``/``age_s`` let receivers
+        dedupe re-notifications of one incident and age it; the burn
+        multiples (with their firing thresholds) say how fast the error
+        budget is going."""
         return {
             "slo": self.slo,
             "state": self.state,
             "target": self.target,
             "severity": self.severity,
             "since": self.since,
+            "age_s": None if self.age_s is None else round(self.age_s, 3),
             "burn_fast": round(self.burn_fast, 3),
             "burn_slow": round(self.burn_slow, 3),
+            "burn_fast_threshold": FAST_BURN,
+            "burn_slow_threshold": SLOW_BURN,
             "description": self.description,
         }
-
-
-@dataclass
-class _Reading:
-    ts: float
-    good: float
-    total: float
 
 
 class SLOTracker:
     """One objective: a good/total reduction over snapshots plus the
     burn-rate state machine. Thread-safe (the monitor loop observes
-    while a CLI/status thread may evaluate)."""
+    while a CLI/status thread may evaluate). Readings live in a
+    :class:`~tpu_kubernetes.obs.tsdb.TSDB` — pass ``store=`` to share
+    the monitor's fleet store (the burn windows then read from the same
+    retained history as every trend column), or omit it for a private
+    one."""
 
     def __init__(self, name: str, target: float,
                  source: Callable[[FleetSnapshot], tuple[float, float]],
-                 for_s: float = 60.0, description: str = ""):
+                 for_s: float = 60.0, description: str = "",
+                 store: TSDB | None = None):
         if not 0.0 < target < 1.0:
             raise ValueError(f"SLO target must be in (0, 1), got {target}")
         self.name = name
@@ -92,7 +106,8 @@ class SLOTracker:
         self.for_s = for_s
         self.description = description
         self._source = source
-        self._history: deque[_Reading] = deque()
+        self.store = store if store is not None else TSDB(max_bytes=1 << 20)
+        self._labels = (("slo", name),)
         self._state = OK
         self._since: float | None = None
         self._lock = threading.Lock()
@@ -102,29 +117,37 @@ class SLOTracker:
         """Record one aggregated cycle's good/total reading."""
         now = time.time() if now is None else now
         good, total = self._source(snapshot)
-        keep_after = now - (max(SLOW_WINDOWS) + 600.0)
         with self._lock:
-            self._history.append(_Reading(now, float(good), float(total)))
-            while self._history and self._history[0].ts < keep_after:
-                self._history.popleft()
+            self.store.append(GOOD_SERIES, float(good), self._labels,
+                              ts=now, kind="counter")
+            self.store.append(TOTAL_SERIES, float(total), self._labels,
+                              ts=now, kind="counter")
+
+    def _reading(self, ts: float) -> tuple[float, float] | None:
+        """The (good, total) pair at the newest sample with timestamp
+        ≤ ts, falling back to the oldest retained reading — with history
+        shorter than the window the oldest reading is the baseline (rate
+        over the data we have; cold starts must not divide by fiction)."""
+        total = (self.store.sample_at_or_before(TOTAL_SERIES, self._labels, ts)
+                 or self.store.first_sample(TOTAL_SERIES, self._labels))
+        if total is None:
+            return None
+        good = (self.store.sample_at_or_before(GOOD_SERIES, self._labels, ts)
+                or self.store.first_sample(GOOD_SERIES, self._labels))
+        return (good[1] if good is not None else 0.0, total[1])
 
     def _burn(self, window: float, now: float) -> float:
-        """Burn multiple over [now - window, now]. With history shorter
-        than the window the oldest reading is the baseline (rate over
-        the data we have — cold starts must not divide by fiction)."""
-        if not self._history:
+        """Burn multiple over [now - window, now], read from the store."""
+        latest = self._reading(float("inf"))
+        if latest is None:
             return 0.0
-        latest = self._history[-1]
-        baseline = self._history[0]
-        cutoff = now - window
-        for reading in reversed(self._history):
-            if reading.ts <= cutoff:
-                baseline = reading
-                break
-        delta_total = latest.total - baseline.total
+        baseline = self._reading(now - window)
+        if baseline is None:
+            return 0.0
+        delta_total = latest[1] - baseline[1]
         if delta_total <= 0:
             return 0.0
-        delta_bad = delta_total - (latest.good - baseline.good)
+        delta_bad = delta_total - (latest[0] - baseline[0])
         ratio = min(1.0, max(0.0, delta_bad) / delta_total)
         return ratio / (1.0 - self.target)
 
@@ -152,7 +175,9 @@ class SLOTracker:
             return Alert(
                 slo=self.name, state=self._state, target=self.target,
                 severity=severity if self._state != OK else "",
-                since=self._since, burn_fast=burn_fast,
+                since=self._since,
+                age_s=None if self._since is None else max(0.0, now - self._since),
+                burn_fast=burn_fast,
                 burn_slow=burn_slow, description=self.description,
             )
 
@@ -195,20 +220,23 @@ def default_slos(availability_target: float = 0.999,
                  latency_target: float = 0.99,
                  ttft_threshold_s: float = 2.5,
                  ttft_target: float = 0.95,
-                 for_s: float = 60.0) -> list[SLOTracker]:
+                 for_s: float = 60.0,
+                 store: TSDB | None = None) -> list[SLOTracker]:
     """The serving fleet's standard objectives — what the ``monitor``
-    CLI evaluates unless handed something else."""
+    CLI evaluates unless handed something else. ``store`` shares one
+    history store across the objectives (and with the fleet scrape
+    series when the monitor passes its own)."""
     return [
         SLOTracker(
             "availability", availability_target, availability_source,
-            for_s=for_s,
+            for_s=for_s, store=store,
             description="non-5xx responses / all responses",
         ),
         SLOTracker(
             "latency", latency_target,
             threshold_source("tpu_serve_request_seconds",
                              latency_threshold_s),
-            for_s=for_s,
+            for_s=for_s, store=store,
             description=(
                 f"requests served within {latency_threshold_s:g}s"
             ),
@@ -217,7 +245,7 @@ def default_slos(availability_target: float = 0.999,
             "ttft", ttft_target,
             threshold_source("tpu_serve_time_to_first_token_seconds",
                              ttft_threshold_s),
-            for_s=for_s,
+            for_s=for_s, store=store,
             description=(
                 f"streams first token within {ttft_threshold_s:g}s"
             ),

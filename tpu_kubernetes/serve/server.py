@@ -517,12 +517,17 @@ class _ContinuousEngine:
     code paths."""
 
     def __init__(self, state: "ServingState", slots: int, seg_steps: int,
-                 page_size: int = 16, pool_mb: float = 0.0):
+                 page_size: int = 16, pool_mb: float = 0.0,
+                 flightrec=None):
         import numpy as np
 
         from tpu_kubernetes.models.decode import init_cache
 
         self._state = state
+        # the flight recorder (obs/flightrec.py) gets one snapshot per
+        # segment and a postmortem dump on every reset — set before the
+        # scheduler thread starts so the first segment can feed it
+        self._flightrec = flightrec
         self.slots = slots
         self.seg_steps = max(1, seg_steps)
         self.span = state.cfg.max_seq
@@ -1259,6 +1264,21 @@ class _ContinuousEngine:
                 entry["event"].set()
                 self._retire(i)
                 drained += 1
+        if self._flightrec is not None:
+            # the black-box feed, BEFORE the per-segment counters zero:
+            # a postmortem's last ring entry must carry this segment's
+            # admissions/reaps, the page partition, and the ledger state
+            self._flightrec.record_segment(
+                steps=steps, slots=self.slots, occupied=occupied,
+                live_steps=live, admitted=self._last_admitted,
+                drained=drained, reaped=self._last_reaped,
+                seconds=round(elapsed, 6), queued=self.depth(),
+                pages=(dict(self._pages.stats()) if self.paged else None),
+                ledger={
+                    "emitted_delta": row_steps if st.ready else 0,
+                    "unsettled": LEDGER.unsettled(),
+                },
+            )
         if st.ready:
             LEDGER.segment(
                 steps=steps, slots=self.slots, occupied=occupied,
@@ -1323,14 +1343,22 @@ class _ContinuousEngine:
         self.recycled += 1
         SLOTS_RECYCLED.inc()
 
-    def _fail_out(self, err: Exception) -> None:
+    def _fail_out(self, err: Exception, reason: str = "engine-reset",
+                  ) -> None:
         """A scheduler-level failure fails every queued AND resident
-        entry out (no submitter may hang) and resets the engine cold."""
+        entry out (no submitter may hang) and resets the engine cold.
+        The flight recorder dumps a postmortem FIRST — the reset below
+        wipes the very state the black box exists to preserve."""
         from tpu_kubernetes.models.decode import init_cache
 
         log.warn(
             f"continuous engine reset: {type(err).__name__}: {err}"
         )
+        if self._flightrec is not None:
+            self._flightrec.dump(reason, extra={
+                "error": f"{type(err).__name__}: {err}"[:500],
+                "restarts": self.restarts,
+            })
         with self._cond:
             queued, self._queue = self._queue, []
         affected = queued + [e for e in self._entries if e is not None]
@@ -1389,7 +1417,7 @@ class _ContinuousEngine:
         per-pass try should have caught), so correctness over grace."""
         self._fail_out(RuntimeError(
             "continuous engine scheduler died — restarted cold"
-        ))
+        ), reason="watchdog-restart")
         self.restarts += 1
         ENGINE_RESTARTS.inc()
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -1527,6 +1555,7 @@ class ServingState:
         batch = int(env.get("SERVER_BATCH", "1"))
         self._batcher = None
         self._engine = None
+        self.flightrec = None
         from tpu_kubernetes.models import MoEConfig
 
         # SERVE_CONTINUOUS_BATCHING=1: replace the round-based batcher
@@ -1647,6 +1676,12 @@ class ServingState:
                 "modes keep their dense caches)"
             )
         if self._continuous:
+            # the engine's black box (obs/flightrec.py): per-segment
+            # snapshots, postmortem dumps on reset/hard-fail/drain,
+            # live at GET /debug/flightrec
+            from tpu_kubernetes.obs.flightrec import FlightRecorder
+
+            self.flightrec = FlightRecorder.from_env(env)
             # created LAST: the scheduler thread uses _prefill_any (the
             # prefix store included), so everything it leans on must be
             # wired first. K = the early-exit interval — admission and
@@ -1657,6 +1692,7 @@ class ServingState:
                            if self.early_exit_steps > 0 else 8),
                 page_size=self.kv_page_size,
                 pool_mb=self.kv_pool_mb,
+                flightrec=self.flightrec,
             )
             # self-healing: a dead scheduler thread would hang every
             # future submitter — restart it cold, bounded times
@@ -1678,6 +1714,12 @@ class ServingState:
         self.failed = True
         events.emit("serve_engine_failed",
                     restarts=self._engine.restarts if self._engine else 0)
+        if self.flightrec is not None:
+            # the terminal postmortem: the watchdog gave up, the fleet
+            # will replace this instance — this dump is what's left
+            self.flightrec.dump("hard-fail", extra={
+                "restarts": self._engine.restarts if self._engine else 0,
+            })
 
     def warm(self) -> None:
         """Compile the programs DEFAULT requests use — the segmented
@@ -1777,6 +1819,12 @@ class ServingState:
         # /metrics stays scrapeable until the listener closes below
         events.emit("serve_drained",
                     reason=self.drain.reason, forced=forced)
+        if self.flightrec is not None:
+            # drain covers SIGTERM too (the handler routes through
+            # begin_drain) — the trigger rides in the payload
+            self.flightrec.dump("drain", extra={
+                "trigger": self.drain.reason, "forced": forced,
+            })
         self.drain.mark_drained()
         log.info("server: drained"
                  + (" (timeout — residual work abandoned)" if forced
@@ -2770,8 +2818,8 @@ class _Handler(BaseHTTPRequestHandler):
     # path-scanning client can't mint unbounded label cardinality
     _ENDPOINTS = frozenset({
         "/healthz", "/metrics", "/v1/models", "/debug/profile",
-        "/debug/ledger", "/v1/completions", "/v1/chat/completions",
-        "/drain",
+        "/debug/ledger", "/debug/flightrec", "/v1/completions",
+        "/v1/chat/completions", "/drain",
     })
 
     def log_message(self, fmt, *args):
@@ -2886,6 +2934,17 @@ class _Handler(BaseHTTPRequestHandler):
                 # stalls explain bubble/shed-spent entries above
                 payload["kv_pages"] = st._engine._pages.stats()
             return self._json(200, payload)
+        if self.path == "/debug/flightrec":
+            # the engine's black box, live and without writing a file:
+            # segment ring, ledger, SLO alerts, recent history samples —
+            # what `tpu-kubernetes get flightrec` renders
+            if st.flightrec is None:
+                return self._json(404, {
+                    "error": "no flight recorder on this instance",
+                    "hint": "the recorder rides the continuous-batching "
+                            "engine (SERVE_CONTINUOUS_BATCHING=1)",
+                })
+            return self._json(200, st.flightrec.snapshot())
         if self.path.startswith("/debug/trace/"):
             # the span tree of one request/run, looked up by the id the
             # response's X-Request-Id header carried
