@@ -3,7 +3,7 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast obs-check monitor-check flightrec-check perf-check goodput-check serve-identity-check serve-continuous-check paged-check sharded-check resilience-check bench dryrun native dist dist-offline clean
+.PHONY: test test-fast obs-check monitor-check flightrec-check alerts-check perf-check goodput-check serve-identity-check serve-continuous-check paged-check sharded-check resilience-check bench dryrun native dist dist-offline clean
 
 test:
 	python -m pytest tests/ -q
@@ -23,11 +23,29 @@ test-fast:
 obs-check:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py \
 	  tests/test_expfmt.py tests/test_tsdb.py tests/test_fleet_obs.py \
+	  tests/test_alerts.py tests/test_incidents.py \
 	  "tests/test_server.py::test_metrics_endpoint_prometheus_exposition" \
 	  "tests/test_server.py::test_healthz_reports_token_counters" \
 	  "tests/test_server.py::test_request_id_on_every_response" \
 	  "tests/test_server.py::test_inbound_request_id_echoed_and_traced" \
 	  -q -m "not slow"
+
+# Alerting & incident gate: the alert manager units (rule vocabulary,
+# lifecycle under injectable clocks, dedup/grouping/silences, JSONL +
+# live-webhook sinks with bounded backoff against a dead endpoint), the
+# incident correlator units (atomic redacted bundles, retention,
+# flightrec cross-refs), the SLO resolve hold-down regression, and the
+# chaos-alerting matrix: every serve site at prob 1.0 yields >= 1 firing
+# tripwire, exactly one closed incident bundle, and one webhook POST per
+# fingerprint (slow-marked, so tier-1 skips it but this target runs it).
+# docs/guide/observability.md "Alerting & incidents".
+alerts-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_alerts.py \
+	  tests/test_incidents.py \
+	  "tests/test_fleet_obs.py::test_slo_resolve_hold_down_prevents_flapping" \
+	  "tests/test_faults.py::test_chaos_alerting_tripwire_incident_and_dedup" \
+	  "tests/test_faults.py::test_alerting_http_and_cli_surfaces" \
+	  -q
 
 # Fleet monitoring smoke: boots two in-process metrics servers, runs
 # `monitor --once --json` against both, and asserts one merged snapshot

@@ -98,7 +98,7 @@ def test_site_vocabulary_is_closed():
     assert set(SITES) == {
         "serve.prefill", "serve.slot_insert", "serve.segment",
         "serve.shard_segment", "serve.prefix_insert", "serve.page_alloc",
-        "fleet.scrape", "shell.terraform",
+        "fleet.scrape", "shell.terraform", "obs.alert_sink",
     }
     assert ENV_VAR == "TPU_K8S_FAULTS"
 
@@ -596,3 +596,239 @@ def test_flightrec_http_endpoint_live(blackbox_chaos_server):
     assert payload["recorder"]["segments"] > 0
     text = render_flightrec(payload)
     assert "flight recorder" in text and "segments in ring" in text
+
+
+# ---------------------------------------------------------------------------
+# alerting chaos matrix: every paged site at prob 1.0 trips an engine
+# tripwire, correlates into exactly one incident bundle, and notifies
+# the webhook once per fingerprint (obs/alerts.py + obs/incidents.py)
+# ---------------------------------------------------------------------------
+
+
+class _AlertWebhook:
+    """A live HTTP endpoint capturing every alert notification POST."""
+
+    def __init__(self):
+        import http.server
+
+        self.posts = []
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):  # noqa: ARG002 — quiet tests
+                pass
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                with outer._lock:
+                    outer.posts.append(json.loads(body))
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                      Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self):
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}/alerts"
+
+    def snapshot(self):
+        with self._lock:
+            return list(self.posts)
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _await_all_clear(state, timeout=60.0):
+    """Poll until no tripwire is pending/firing and no incident is open
+    — the scheduler's idle alert tick resolves alerts and closes
+    incidents on a quiet engine, so this converges without traffic."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        summary = state.alerts.summary()
+        if (summary["firing"] == 0 and summary["pending"] == 0
+                and state._incidents.current_incident_id() is None):
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        f"alerts never cleared: {state.alerts.summary()}, "
+        f"open incident {state._incidents.current_incident_id()}"
+    )
+
+
+@pytest.fixture(scope="module")
+def alerting_chaos_server(tmp_path_factory):
+    """A paged continuous-batching server with the full incident
+    pipeline armed: tripwires firing instantly (FOR_S=0), a short
+    symmetric resolve hold bridging sub-second clean gaps mid-chaos
+    (RESOLVE_FOR_S=2), incidents closing 2s after all-clear, and a live
+    webhook flushed every evaluate (GROUP_S=0) so the dedup under test
+    is the firing-transition contract itself, not batching."""
+    from tpu_kubernetes.serve.server import make_server
+
+    recv = _AlertWebhook()
+    incidents_dir = str(tmp_path_factory.mktemp("incidents"))
+    srv = make_server(dict(
+        ENV, SERVER_HOST="127.0.0.1", SERVER_PORT="0",
+        SERVE_CONTINUOUS_BATCHING="1", SERVER_BATCH="2",
+        SERVE_PREFIX_CACHE_MB="4",
+        SERVE_KV_POOL_MB="0.25", SERVE_KV_PAGE_SIZE="16",
+        TPU_K8S_FLIGHTREC_DIR=str(tmp_path_factory.mktemp("fr-alerts")),
+        TPU_K8S_INCIDENTS_DIR=incidents_dir,
+        TPU_K8S_INCIDENTS_CLOSE_S="2",
+        TPU_K8S_ALERT_FOR_S="0",
+        TPU_K8S_ALERT_RESOLVE_FOR_S="2",
+        TPU_K8S_ALERT_TICK_S="0",
+        TPU_K8S_ALERT_GROUP_S="0",
+        TPU_K8S_ALERT_WEBHOOK=recv.url,
+    ))
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    state = srv.RequestHandlerClass.state
+    # warm-up: compile, then let the first traffic's transient tripwires
+    # (with FOR_S=0 the ledger is legitimately "unbalanced" while tokens
+    # are in flight) resolve and any warm-up incident close before the
+    # matrix starts counting bundles and webhook posts
+    state.complete("pack my box", max_new_tokens=3)
+    _await_all_clear(state)
+    yield srv, incidents_dir, recv
+    srv.shutdown()
+    recv.stop()
+
+
+def _bundle_files(incidents_dir):
+    import os
+
+    return {
+        n for n in os.listdir(incidents_dir)
+        if n.startswith("incident-") and n.endswith(".json")
+    }
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site", PAGED_SITES)
+def test_chaos_alerting_tripwire_incident_and_dedup(
+    alerting_chaos_server, site,
+):
+    """Acceptance: chaos at every serve site at prob 1.0 trips at least
+    one engine tripwire, correlates into EXACTLY one closed incident
+    bundle — atomic, redacted, cross-referenced with flight-recorder
+    dumps, conservation-checkable offline — and the webhook saw one
+    firing notification per tripwire fingerprint (dedup holds)."""
+    import os
+
+    srv, incidents_dir, recv = alerting_chaos_server
+    state = srv.RequestHandlerClass.state
+    before_files = _bundle_files(incidents_dir)
+    posts_before = len(recv.snapshot())
+
+    with injected(f"{site}:1.0:11"):
+        _fan_out_chaotic(state, PROMPTS)
+    # chaos over: drain immediately — the clean request keeps the engine
+    # evaluating while every tripwire that fired holds through its clean
+    # window, so they all merge into one incident instead of flapping
+    state.complete("pack my box", max_new_tokens=3)
+    _quiesce(state)
+    _await_all_clear(state)
+
+    new = sorted(_bundle_files(incidents_dir) - before_files)
+    assert len(new) == 1, new                       # exactly one incident
+    assert not [n for n in os.listdir(incidents_dir) if ".tmp" in n]
+
+    with open(os.path.join(incidents_dir, new[0]), encoding="utf-8") as f:
+        raw = f.read()
+    for prompt in PROMPTS:                          # redaction holds
+        assert prompt not in raw
+    bundle = json.loads(raw)                        # atomic + parseable
+    assert bundle["schema"] == "tpu-k8s-incident/1"
+    assert bundle["status"] == "closed"
+    assert bundle["alerts"]                         # ≥1 firing tripwire
+    assert "fault-injected" in bundle["rules"]      # the universal canary
+    assert bundle["faults_injected"].get(site, 0) > 0
+
+    # cross-refs both ways: the bundle lists the incident-open dump, and
+    # that dump carries this incident's id back
+    assert bundle["flightrec_dumps"]
+    stamped = [_load_dump(p) for p in bundle["flightrec_dumps"]
+               if os.path.exists(p)]
+    assert any(d.get("incident_id") == bundle["incident_id"]
+               for d in stamped)
+
+    # the embedded ledger is conservation-checkable from the file alone
+    ledger = bundle["ledger"]
+    assert (sum(ledger["classes"].values()) + ledger["unsettled"]
+            == ledger["emitted"])
+
+    # webhook dedup: a held firing state is never re-notified — at most
+    # one firing post per fingerprint (two only if a tripwire genuinely
+    # resolved and re-fired inside this window), and the fault-injected
+    # canary fires exactly once
+    firing_counts: dict[str, int] = {}
+    canary_fps = set()
+    for batch in recv.snapshot()[posts_before:]:
+        for a in batch["alerts"]:
+            if a["state"] == "firing":
+                firing_counts[a["fingerprint"]] = (
+                    firing_counts.get(a["fingerprint"], 0) + 1
+                )
+                if a["rule"] == "fault-injected":
+                    canary_fps.add(a["fingerprint"])
+    assert firing_counts                            # the webhook saw chaos
+    assert len(canary_fps) == 1
+    assert firing_counts[next(iter(canary_fps))] == 1
+    assert all(n <= 2 for n in firing_counts.values()), firing_counts
+
+
+@pytest.mark.slow
+def test_alerting_http_and_cli_surfaces(alerting_chaos_server, capsys):
+    """GET /debug/alerts serves the manager snapshot, /healthz mirrors
+    the summary, and the CLI renders live alerts and offline incident
+    bundles from this server's pipeline."""
+    import http.client
+
+    from tpu_kubernetes.cli.main import main as cli_main
+    from tpu_kubernetes.obs.alerts import fetch_alerts
+
+    srv, incidents_dir, _recv = alerting_chaos_server
+    state = srv.RequestHandlerClass.state
+    state.complete(PROMPTS[0], max_new_tokens=3)
+    host, port = srv.server_address[:2]
+
+    payload = fetch_alerts(f"{host}:{port}")         # GET /debug/alerts
+    assert payload["schema"] == "tpu-k8s-alerts/1"
+    names = {r["name"] for r in payload["rules"]}
+    assert {"page-partition-leak", "ledger-conservation",
+            "fault-injected", "queue-runaway"} <= names
+
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    conn.request("GET", "/healthz")
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    conn.close()
+    assert resp.status == 200
+    assert set(body["alerts"]) == {"firing", "pending", "by_severity"}
+
+    assert cli_main(["get", "alerts",
+                     "--target", f"{host}:{port}"]) == 0
+    out = capsys.readouterr().out
+    assert "rules" in out
+    assert cli_main(["get", "alerts", "--target", f"{host}:{port}",
+                     "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["schema"] \
+        == "tpu-k8s-alerts/1"
+
+    assert cli_main(["get", "incidents", "--dir", incidents_dir,
+                     "--json"]) == 0
+    bundles = json.loads(capsys.readouterr().out)
+    assert isinstance(bundles, list)
+    assert cli_main(["get", "incidents", "--dir", incidents_dir]) == 0
+    capsys.readouterr()

@@ -282,6 +282,54 @@ def test_availability_burn_alert_lifecycle(two_workers):
     assert alert.state == "ok" and alert.since is None  # fully resolved
 
 
+def test_slo_resolve_hold_down_prevents_flapping():
+    """Regression: a firing SLO must stay clean ``resolve_for_s`` before
+    it resolves, so burn hovering at the threshold cannot strobe
+    firing/resolved at the pager — and a re-breach during the hold keeps
+    the ORIGINAL firing alert (no ok→pending round trip). The default
+    ``resolve_for_s=0`` preserves the historical instant resolve (the
+    lifecycle test above exercises that path)."""
+    reading = {"good": 100.0, "total": 100.0}
+    slo = SLOTracker(
+        "availability", 0.99,
+        lambda _snap: (reading["good"], reading["total"]),
+        for_s=60.0, resolve_for_s=600.0,
+    )
+    assert SLOTracker("availability", 0.99,
+                      availability_source).resolve_for_s == 0.0
+
+    def cycle(now, good=0.0, bad=0.0):
+        reading["good"] += good
+        reading["total"] += good + bad
+        slo.observe(None, now=now)
+        return slo.evaluate(now=now)
+
+    t0 = 1_000_000.0
+    assert cycle(t0).state == "ok"
+    assert cycle(t0 + 60, bad=100).state == "pending"
+    alert = cycle(t0 + 120, bad=10)
+    assert alert.state == "firing" and alert.since == t0 + 60
+
+    # burn goes fully clean — before the fix this resolved instantly;
+    # now the hold keeps it firing (and still paging) for resolve_for_s
+    alert = cycle(t0 + 30_000, good=100_000)
+    assert alert.state == "firing" and alert.severity == "page"
+    alert = cycle(t0 + 30_300, good=100)         # clean 300s < 600s hold
+    assert alert.state == "firing"
+
+    # re-breach DURING the hold: the same alert keeps firing with its
+    # original since — no resolve/refire strobe ever reached the pager
+    alert = cycle(t0 + 30_360, bad=200_000)
+    assert alert.state == "firing" and alert.severity == "page"
+    assert alert.since == t0 + 60
+
+    # clean again, and STAY clean through the full hold → resolved
+    alert = cycle(t0 + 60_360, good=10_000_000)
+    assert alert.state == "firing"               # hold restarts
+    alert = cycle(t0 + 60_960, good=100)         # clean ≥ resolve_for_s
+    assert alert.state == "ok" and alert.since is None
+
+
 def test_threshold_source_reads_cumulative_buckets(two_workers):
     a, b = two_workers
     # a has one 0.05s request; b one 0.05s; add two slow ones to b

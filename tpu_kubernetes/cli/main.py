@@ -83,7 +83,8 @@ def build_parser() -> argparse.ArgumentParser:
     get.add_argument(
         "kind",
         choices=["manager", "cluster", "kubeconfig", "runs", "metrics",
-                 "profile", "goodput", "history", "flightrec"],
+                 "profile", "goodput", "history", "flightrec", "alerts",
+                 "incidents"],
         help="profile renders the worker's phase table — cold (prefill) "
              "vs warm (prefill_warm) prefills split out, so prefix-cache "
              "savings are read off one row pair; goodput renders the "
@@ -92,7 +93,9 @@ def build_parser() -> argparse.ArgumentParser:
              "history scrapes a metric over a few spaced cycles and "
              "renders per-series latest/rate/min/max + a sparkline; "
              "flightrec renders the engine's live black box "
-             "(GET /debug/flightrec)",
+             "(GET /debug/flightrec); alerts renders the worker's rule "
+             "alerts and silences (GET /debug/alerts); incidents lists "
+             "local incident bundles (see --dir)",
     )
     get.add_argument(
         "metric", nargs="?", metavar="METRIC",
@@ -105,13 +108,19 @@ def build_parser() -> argparse.ArgumentParser:
     )
     get.add_argument(
         "--json", dest="as_json", action="store_true",
-        help="with runs/profile/goodput/history/flightrec: dump the raw "
-             "JSON instead of the table",
+        help="with runs/profile/goodput/history/flightrec/alerts/"
+             "incidents: dump the raw JSON instead of the table",
     )
     get.add_argument(
         "--target", metavar="HOST:PORT", default="127.0.0.1:8000",
-        help="with profile/goodput/flightrec: the serving worker to "
-             "query (default 127.0.0.1:8000)",
+        help="with profile/goodput/flightrec/alerts: the serving worker "
+             "to query (default 127.0.0.1:8000)",
+    )
+    get.add_argument(
+        "--dir", dest="incidents_dir", metavar="DIR",
+        default=None,
+        help="with incidents: the bundle directory to list (default "
+             "runs/incidents, or TPU_K8S_INCIDENTS_DIR)",
     )
     get.add_argument(
         "--targets", metavar="HOST:PORT[,HOST:PORT...]", default=None,
@@ -310,6 +319,44 @@ def main(argv: list[str] | None = None) -> int:
             print(json.dumps(data, indent=2, sort_keys=True))
         else:
             print(render_flightrec(data), end="")
+        return 0
+
+    if args.command == "get" and args.kind == "alerts":
+        # a remote worker's GET /debug/alerts, rendered — same stance
+        # as get flightrec
+        from tpu_kubernetes.obs.alerts import fetch_alerts, render_alerts
+
+        try:
+            data = fetch_alerts(args.target)
+        except Exception as e:  # noqa: BLE001 — network errors → exit 1
+            print(f"error: cannot fetch alerts from {args.target}: {e}",
+                  file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps(data, indent=2, sort_keys=True))
+        else:
+            print(render_alerts(data), end="")
+        return 0
+
+    if args.command == "get" and args.kind == "incidents":
+        # local incident bundles (obs/incidents.py writes them next to
+        # runs/ reports) — offline postmortems need no live worker
+        import os as _os
+
+        from tpu_kubernetes.obs.incidents import (
+            DEFAULT_DIR as _INCIDENTS_DIR,
+            list_incidents,
+            render_incidents,
+        )
+
+        directory = (args.incidents_dir
+                     or _os.environ.get("TPU_K8S_INCIDENTS_DIR", "")
+                     or _INCIDENTS_DIR)
+        payloads = list_incidents(directory)
+        if args.as_json:
+            print(json.dumps(payloads, indent=2, sort_keys=True))
+        else:
+            print(render_incidents(payloads), end="")
         return 0
 
     if args.command == "bench":

@@ -166,7 +166,7 @@ class FleetAggregator:
 
     def __init__(self, targets: list[str], timeout_s: float = 2.0,
                  retries: int = 1, max_workers: int = 16,
-                 backoff_base_s: float = 0.0, tsdb=None):
+                 backoff_base_s: float = 0.0, tsdb=None, alerts=None):
         self._targets = [_normalize_target(t) for t in targets]
         if not self._targets:
             raise ValueError("FleetAggregator needs at least one target")
@@ -182,6 +182,11 @@ class FleetAggregator:
         # receives every merged cycle — the one source of truth the SLO
         # burn windows, monitor trends, and `get history` all read
         self._tsdb = tsdb
+        # an alert manager (obs/alerts.py, duck-typed:
+        # .evaluate(snapshot=, store=, now=)) evaluated against every
+        # merged cycle — headless callers (a future autoscaler) get
+        # rule evaluation without running the monitor loop
+        self._alerts = alerts
         self._max_workers = max(1, min(max_workers, len(self._targets)))
         self._lock = threading.Lock()
         self._health: dict[str, TargetHealth] = {
@@ -309,6 +314,12 @@ class FleetAggregator:
             try:
                 self._tsdb.ingest(snapshot)
             except Exception:  # noqa: BLE001 — history must not fail a scrape
+                pass
+        if self._alerts is not None:
+            try:
+                self._alerts.evaluate(snapshot=snapshot, store=self._tsdb,
+                                      now=now)
+            except Exception:  # noqa: BLE001 — alerting must not fail a scrape
                 pass
         return snapshot
 

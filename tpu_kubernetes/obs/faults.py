@@ -50,6 +50,7 @@ SITES = frozenset({
     "serve.page_alloc",     # PagePool.allocate (paged admission/top-up)
     "fleet.scrape",         # FleetAggregator per-target fetch
     "shell.terraform",      # TerraformExecutor subprocess run
+    "obs.alert_sink",       # alert notification delivery (obs/alerts.py)
 })
 
 FAULTS_INJECTED = REGISTRY.counter(

@@ -109,9 +109,13 @@ class FlightRecorder:
 
     def __init__(self, directory: str = DEFAULT_DIR, keep: int = DEFAULT_KEEP,
                  capacity: int = DEFAULT_SEGMENTS, registry=None,
-                 ledger=None, tracer=None, slos=None):
+                 ledger=None, tracer=None, slos=None, incidents=None):
         self.directory = directory
         self.keep = max(1, int(keep))
+        # the incident correlator (obs/incidents.py), when one is wired:
+        # dumps taken during an open incident carry its id, and every
+        # dump path is reported back for the bundle's cross-ref list
+        self.incidents = incidents
         self._registry = REGISTRY if registry is None else registry
         self._ledger = LEDGER if ledger is None else ledger
         self._tracer = TRACER if tracer is None else tracer
@@ -241,6 +245,11 @@ class FlightRecorder:
         }
         if extra:
             payload["extra"] = extra
+        if self.incidents is not None:
+            try:
+                payload["incident_id"] = self.incidents.current_incident_id()
+            except Exception:  # noqa: BLE001
+                payload["incident_id"] = None
         return redact(payload)
 
     # -- persistence --------------------------------------------------------
@@ -286,7 +295,13 @@ class FlightRecorder:
             from tpu_kubernetes.obs import events
 
             events.emit("flightrec_dump", reason=reason, path=path,
-                        segments=len(payload.get("segments", [])))
+                        segments=len(payload.get("segments", [])),
+                        incident_id=payload.get("incident_id"))
+            if self.incidents is not None:
+                try:
+                    self.incidents.note_flightrec_dump(path)
+                except Exception:  # noqa: BLE001
+                    pass
             return path
         except Exception:  # noqa: BLE001 — the postmortem writer must not
             with self._lock:  # crash the patient

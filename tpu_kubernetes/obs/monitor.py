@@ -24,6 +24,7 @@ sparkline — the trends a fleet controller will scale on, on demand.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 from typing import Any, Callable, TextIO
@@ -160,10 +161,13 @@ def _fmt(value: Any, unit: str = "", width: int = 8) -> str:
 
 
 def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
-                 ts: float | None = None) -> str:
+                 ts: float | None = None,
+                 rule_alerts: list[dict[str, Any]] | None = None) -> str:
     """The human rendering: one aligned row per instance (trend columns
     when the rows carry history sparklines), then any pending/firing
-    alerts."""
+    alerts — the SLO trackers' burn alerts plus, when an alert manager
+    runs, its rule alerts (``rule_alerts``; slo_burn rules are skipped
+    there since the tracker rows already show them)."""
     with_trends = any("spark" in row for row in rows)
     header = (
         f"{'INSTANCE':<24} {'UP':>2} {'VER':>8} {'RPS':>8} {'P50':>8} "
@@ -205,7 +209,13 @@ def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
                 f"{row['error']}"
             )
     active = [a for a in alerts if a.state != "ok"]
-    if active:
+    # the manager's non-SLO rule alerts (tripwires, anomaly detectors);
+    # slo_burn entries would duplicate the tracker rows above
+    extra = [
+        a for a in (rule_alerts or [])
+        if a.get("state") not in ("ok",) and a.get("kind") != "slo_burn"
+    ]
+    if active or extra:
         lines.append("")
         lines.append("ALERTS")
         for a in active:
@@ -215,17 +225,38 @@ def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
                 f" burn fast={a.burn_fast:.1f}x slow={a.burn_slow:.1f}x{age}"
                 f"{' — ' + a.description if a.description else ''}"
             )
+        for a in extra:
+            labels = ",".join(
+                f"{k}={v}" for k, v in sorted(a.get("labels", {}).items())
+            )
+            age = (f" for {a['age_s']:.0f}s"
+                   if a.get("age_s") is not None else "")
+            lines.append(
+                f"  [{a.get('state', '?').upper():>7}] {a.get('rule')}"
+                f"{'{' + labels + '}' if labels else ''}"
+                f" severity={a.get('severity') or '-'}{age}"
+                f"{' — ' + a['summary'] if a.get('summary') else ''}"
+                f"{' (silenced)' if a.get('silenced') else ''}"
+            )
     return "\n".join(lines) + "\n"
 
 
 def snapshot_json(snapshot: FleetSnapshot, rows: list[dict[str, Any]],
-                  alerts: list[Alert]) -> dict[str, Any]:
-    """One cycle as a JSON-ready object (``monitor --json``)."""
-    return {
+                  alerts: list[Alert],
+                  rule_alerts: list[dict[str, Any]] | None = None,
+                  ) -> dict[str, Any]:
+    """One cycle as a JSON-ready object (``monitor --json``). The
+    ``alerts`` key keeps the historical tracker-alert shape;
+    ``rule_alerts`` (when an alert manager runs) carries the manager's
+    fingerprinted view of everything, SLO burn included."""
+    out = {
         "ts": snapshot.ts,
         "instances": {row["instance"]: row for row in rows},
         "alerts": [a.to_dict() for a in alerts],
     }
+    if rule_alerts is not None:
+        out["rule_alerts"] = rule_alerts
+    return out
 
 
 def run_monitor(targets: list[str], interval: float = 5.0,
@@ -235,10 +266,16 @@ def run_monitor(targets: list[str], interval: float = 5.0,
                 max_cycles: int | None = None,
                 timeout_s: float = 2.0,
                 window: float = 60.0,
-                store: TSDB | None = None) -> int:
+                store: TSDB | None = None,
+                alert_manager=None) -> int:
     """The CLI loop. Returns the process exit code. ``store`` lets a
     caller pre-seed (or retain) fleet history across invocations; by
-    default each run owns a fresh one."""
+    default each run owns a fresh one. ``alert_manager`` takes a
+    pre-built :class:`~tpu_kubernetes.obs.alerts.AlertManager`; by
+    default the loop builds one from the SLO trackers plus the standard
+    fleet rules (target-down, restart delta, latency drift, counter
+    stall, queue runaway), env-configured sinks, and any
+    ``TPU_K8S_ALERTS_D`` rule files — evaluated every scrape cycle."""
     out = sys.stdout if out is None else out
     store = TSDB() if store is None else store
     # the poll interval doubles as the backoff base: a dead target falls
@@ -250,6 +287,25 @@ def run_monitor(targets: list[str], interval: float = 5.0,
         tsdb=store,
     )
     trackers = default_slos(store=store) if slos is None else slos
+    manager = alert_manager
+    owns_manager = manager is None
+    if owns_manager:
+        from tpu_kubernetes.obs import alerts as alerts_mod
+
+        rules = alerts_mod.default_fleet_rules(trackers)
+        rules_d = os.environ.get("TPU_K8S_ALERTS_D", "")
+        if rules_d:
+            try:
+                rules += alerts_mod.load_rules(rules_d)
+            except Exception as e:  # noqa: BLE001 — a bad rule file is
+                print(f"warning: TPU_K8S_ALERTS_D: {e}",  # operator error,
+                      file=sys.stderr)                    # not a crash
+        manager = alerts_mod.AlertManager(
+            rules, sinks=alerts_mod.sinks_from_env(),
+            group_interval_s=float(
+                os.environ.get("TPU_K8S_ALERT_GROUP_S", "60") or 60
+            ),
+        )
     cycles = 0
     try:
         while True:
@@ -271,12 +327,20 @@ def run_monitor(targets: list[str], interval: float = 5.0,
             for tracker in trackers:
                 tracker.observe(snapshot, now=snapshot.ts)
             alerts = [t.evaluate(now=snapshot.ts) for t in trackers]
+            # the manager's SLOBurnRule re-evaluates the same trackers at
+            # the same `now` — the state machine is idempotent per instant
+            rule_alerts = manager.evaluate(
+                snapshot=snapshot, store=store, now=snapshot.ts
+            )
             rows = fleet_rows(snapshot, store=store, window=window)
             if as_json:
-                print(json.dumps(snapshot_json(snapshot, rows, alerts),
-                                 sort_keys=True), file=out, flush=True)
+                print(json.dumps(
+                    snapshot_json(snapshot, rows, alerts,
+                                  rule_alerts=rule_alerts),
+                    sort_keys=True), file=out, flush=True)
             else:
-                print(render_table(rows, alerts, ts=snapshot.ts),
+                print(render_table(rows, alerts, ts=snapshot.ts,
+                                   rule_alerts=rule_alerts),
                       file=out, flush=True)
             cycles += 1
             if once or (max_cycles is not None and cycles >= max_cycles):
@@ -284,6 +348,9 @@ def run_monitor(targets: list[str], interval: float = 5.0,
             time.sleep(interval)
     except KeyboardInterrupt:
         return 0
+    finally:
+        if owns_manager:
+            manager.close()
 
 
 def run_history(metric: str, targets: list[str], window: float = 60.0,

@@ -20,9 +20,13 @@ Requiring both windows makes alerts resolve quickly once the bleeding
 stops (the short window goes clean first) without flapping on blips.
 
 Alerts move ``ok → pending → firing``: a breach must hold for ``for_s``
-seconds before it pages, and any clean evaluation resolves it. Clocks
-are injectable (``now=``) so tests can drive hours of window arithmetic
-in milliseconds; production callers just omit it.
+seconds before it pages, and — symmetrically — a firing alert must stay
+clean for ``resolve_for_s`` seconds before it resolves, so burn
+hovering at the threshold cannot strobe firing/resolved at the pager
+(``resolve_for_s=0``, the default, keeps the historical
+instant-resolve). Clocks are injectable (``now=``) so tests can drive
+hours of window arithmetic in milliseconds; production callers just
+omit it.
 """
 
 from __future__ import annotations
@@ -98,18 +102,21 @@ class SLOTracker:
     def __init__(self, name: str, target: float,
                  source: Callable[[FleetSnapshot], tuple[float, float]],
                  for_s: float = 60.0, description: str = "",
-                 store: TSDB | None = None):
+                 store: TSDB | None = None, resolve_for_s: float = 0.0):
         if not 0.0 < target < 1.0:
             raise ValueError(f"SLO target must be in (0, 1), got {target}")
         self.name = name
         self.target = target
         self.for_s = for_s
+        self.resolve_for_s = max(0.0, float(resolve_for_s))
         self.description = description
         self._source = source
         self.store = store if store is not None else TSDB(max_bytes=1 << 20)
         self._labels = (("slo", name),)
         self._state = OK
         self._since: float | None = None
+        self._clear_since: float | None = None  # resolve hold-down anchor
+        self._last_severity = ""                # shown while holding clean
         self._lock = threading.Lock()
 
     def observe(self, snapshot: FleetSnapshot,
@@ -165,16 +172,33 @@ class SLOTracker:
             else:
                 severity = ""
             if severity:
+                self._clear_since = None
+                self._last_severity = severity
                 if self._state == OK:
                     self._state, self._since = PENDING, now
-                elif (self._state == PENDING
-                        and now - (self._since or now) >= self.for_s):
+                elif (self._state == PENDING and self._since is not None
+                        and now - self._since >= self.for_s):
                     self._state = FIRING
+            elif self._state == FIRING and self.resolve_for_s > 0:
+                # symmetric hold-down: a firing alert must stay clean
+                # resolve_for_s before resolving, so burn hovering at
+                # the threshold doesn't strobe firing/resolved
+                if self._clear_since is None:
+                    self._clear_since = now
+                if now - self._clear_since >= self.resolve_for_s:
+                    self._state, self._since = OK, None
+                    self._clear_since = None
+                    self._last_severity = ""
             else:
                 self._state, self._since = OK, None
+                self._clear_since = None
+                self._last_severity = ""
+            shown = severity or (
+                self._last_severity if self._state == FIRING else ""
+            )
             return Alert(
                 slo=self.name, state=self._state, target=self.target,
-                severity=severity if self._state != OK else "",
+                severity=shown if self._state != OK else "",
                 since=self._since,
                 age_s=None if self._since is None else max(0.0, now - self._since),
                 burn_fast=burn_fast,
@@ -221,7 +245,8 @@ def default_slos(availability_target: float = 0.999,
                  ttft_threshold_s: float = 2.5,
                  ttft_target: float = 0.95,
                  for_s: float = 60.0,
-                 store: TSDB | None = None) -> list[SLOTracker]:
+                 store: TSDB | None = None,
+                 resolve_for_s: float = 60.0) -> list[SLOTracker]:
     """The serving fleet's standard objectives — what the ``monitor``
     CLI evaluates unless handed something else. ``store`` shares one
     history store across the objectives (and with the fleet scrape
@@ -229,14 +254,14 @@ def default_slos(availability_target: float = 0.999,
     return [
         SLOTracker(
             "availability", availability_target, availability_source,
-            for_s=for_s, store=store,
+            for_s=for_s, store=store, resolve_for_s=resolve_for_s,
             description="non-5xx responses / all responses",
         ),
         SLOTracker(
             "latency", latency_target,
             threshold_source("tpu_serve_request_seconds",
                              latency_threshold_s),
-            for_s=for_s, store=store,
+            for_s=for_s, store=store, resolve_for_s=resolve_for_s,
             description=(
                 f"requests served within {latency_threshold_s:g}s"
             ),
@@ -245,7 +270,7 @@ def default_slos(availability_target: float = 0.999,
             "ttft", ttft_target,
             threshold_source("tpu_serve_time_to_first_token_seconds",
                              ttft_threshold_s),
-            for_s=for_s, store=store,
+            for_s=for_s, store=store, resolve_for_s=resolve_for_s,
             description=(
                 f"streams first token within {ttft_threshold_s:g}s"
             ),

@@ -293,6 +293,11 @@ def _bucket(n: int, lo: int = 16) -> int:
 # resume program; also the floor of _pow2_floor
 MIN_PREFIX_TOKENS = 16
 
+# how long an IDLE slot-engine scheduler waits before waking to run an
+# alert tick anyway (alerts must resolve and incidents must close on a
+# quiet engine, not only while traffic flows)
+_ALERT_IDLE_WAIT_S = 0.5
+
 
 def _pow2_floor(n: int, lo: int = MIN_PREFIX_TOKENS) -> int:
     """Largest power of two <= n (0 when n < lo). Reused-prefix lengths
@@ -518,7 +523,7 @@ class _ContinuousEngine:
 
     def __init__(self, state: "ServingState", slots: int, seg_steps: int,
                  page_size: int = 16, pool_mb: float = 0.0,
-                 flightrec=None):
+                 flightrec=None, alerts=None):
         import numpy as np
 
         from tpu_kubernetes.models.decode import init_cache
@@ -528,6 +533,15 @@ class _ContinuousEngine:
         # segment and a postmortem dump on every reset — set before the
         # scheduler thread starts so the first segment can feed it
         self._flightrec = flightrec
+        # the engine-local alert manager (obs/alerts.py): tripwires
+        # evaluated on THIS thread between scheduler passes (and on a
+        # timed idle wait, so alerts resolve and incidents close without
+        # traffic), throttled by TPU_K8S_ALERT_TICK_S
+        self._alerts = alerts
+        self._alert_tick_s = float(
+            state.env.get("TPU_K8S_ALERT_TICK_S", "1") or 0
+        )
+        self._last_alert_tick = 0.0
         self.slots = slots
         self.seg_steps = max(1, seg_steps)
         self.span = state.cfg.max_seq
@@ -704,13 +718,45 @@ class _ContinuousEngine:
                 while not self._queue and all(
                     e is None for e in self._entries
                 ):
-                    self._cond.wait()
+                    if self._alerts is None:
+                        self._cond.wait()
+                    elif not self._cond.wait(timeout=_ALERT_IDLE_WAIT_S):
+                        # idle timeout: fall through so the tripwires
+                        # below still evaluate (alerts resolve and
+                        # incidents close on a quiet engine)
+                        break
+                idle = not self._queue and all(
+                    e is None for e in self._entries
+                )
             try:
-                self._reap()
-                self._admit()
-                self._run_segment()
+                if not idle:
+                    self._reap()
+                    self._admit()
+                    self._run_segment()
             except Exception as e:  # noqa: BLE001 — surfaced per entry
                 self._fail_out(e)
+            self._alerts_tick()
+
+    def _alerts_tick(self) -> None:
+        """Evaluate the engine-local tripwires on the scheduler thread,
+        throttled to TPU_K8S_ALERT_TICK_S. Never raises, never blocks on
+        sink I/O (deliveries ride the manager's notifier thread)."""
+        if self._alerts is None:
+            return
+        now = time.time()
+        if now - self._last_alert_tick < self._alert_tick_s:
+            return
+        self._last_alert_tick = now
+        try:
+            from tpu_kubernetes.obs.alerts import engine_local_context
+
+            self._alerts.evaluate(engine_local_context(
+                self._alerts.rules, now,
+                store=(self._flightrec.store
+                       if self._flightrec is not None else None),
+            ))
+        except Exception:  # noqa: BLE001 — alerting must not take down
+            pass           # the scheduler
 
     def _reap(self) -> None:
         """Retire expired/cancelled RESIDENT rows mid-flight: the entry
@@ -1556,6 +1602,8 @@ class ServingState:
         self._batcher = None
         self._engine = None
         self.flightrec = None
+        self.alerts = None       # engine-local AlertManager (obs/alerts.py)
+        self._incidents = None   # IncidentCorrelator (obs/incidents.py)
         from tpu_kubernetes.models import MoEConfig
 
         # SERVE_CONTINUOUS_BATCHING=1: replace the round-based batcher
@@ -1679,9 +1727,45 @@ class ServingState:
             # the engine's black box (obs/flightrec.py): per-segment
             # snapshots, postmortem dumps on reset/hard-fail/drain,
             # live at GET /debug/flightrec
+            from tpu_kubernetes.obs.alerts import (
+                AlertManager,
+                engine_tripwires,
+                sinks_from_env,
+            )
             from tpu_kubernetes.obs.flightrec import FlightRecorder
+            from tpu_kubernetes.obs.incidents import IncidentCorrelator
 
             self.flightrec = FlightRecorder.from_env(env)
+            # the incident correlator shares the recorder's history
+            # store (bundles embed the series the alerts fired on) and
+            # cross-refs dumps both ways (obs/incidents.py)
+            self._incidents = IncidentCorrelator.from_env(
+                env, store=self.flightrec.store, flightrec=self.flightrec,
+            )
+            self.flightrec.incidents = self._incidents
+            # the engine-local tripwires: page partition, ledger
+            # conservation, restart/5xx/fault counter deltas, counter
+            # stall, queue runaway — evaluated on the scheduler thread,
+            # live at GET /debug/alerts, mirrored into /healthz
+            self.alerts = AlertManager(
+                engine_tripwires(
+                    stats_fn=lambda: (self._engine.stats()
+                                      if self._engine is not None else None),
+                    ledger=LEDGER,
+                    for_s=float(env.get("TPU_K8S_ALERT_FOR_S", "5") or 0),
+                    resolve_for_s=float(
+                        env.get("TPU_K8S_ALERT_RESOLVE_FOR_S", "10") or 0
+                    ),
+                    queue_max_depth=float(
+                        env.get("SERVE_MAX_QUEUE", "256") or 256
+                    ),
+                ),
+                sinks=sinks_from_env(env),
+                group_interval_s=float(
+                    env.get("TPU_K8S_ALERT_GROUP_S", "60") or 0
+                ),
+                incidents=self._incidents,
+            )
             # created LAST: the scheduler thread uses _prefill_any (the
             # prefix store included), so everything it leans on must be
             # wired first. K = the early-exit interval — admission and
@@ -1693,6 +1777,7 @@ class ServingState:
                 page_size=self.kv_page_size,
                 pool_mb=self.kv_pool_mb,
                 flightrec=self.flightrec,
+                alerts=self.alerts,
             )
             # self-healing: a dead scheduler thread would hang every
             # future submitter — restart it cold, bounded times
@@ -2818,8 +2903,8 @@ class _Handler(BaseHTTPRequestHandler):
     # path-scanning client can't mint unbounded label cardinality
     _ENDPOINTS = frozenset({
         "/healthz", "/metrics", "/v1/models", "/debug/profile",
-        "/debug/ledger", "/debug/flightrec", "/v1/completions",
-        "/v1/chat/completions", "/drain",
+        "/debug/ledger", "/debug/flightrec", "/debug/alerts",
+        "/v1/completions", "/v1/chat/completions", "/drain",
     })
 
     def log_message(self, fmt, *args):
@@ -2945,6 +3030,17 @@ class _Handler(BaseHTTPRequestHandler):
                             "engine (SERVE_CONTINUOUS_BATCHING=1)",
                 })
             return self._json(200, st.flightrec.snapshot())
+        if self.path == "/debug/alerts":
+            # the engine-local tripwire state: active/resolved alerts,
+            # silences, the registered rules — what `tpu-kubernetes
+            # get alerts` renders
+            if st.alerts is None:
+                return self._json(404, {
+                    "error": "no alert manager on this instance",
+                    "hint": "engine-local tripwires ride the continuous-"
+                            "batching engine (SERVE_CONTINUOUS_BATCHING=1)",
+                })
+            return self._json(200, st.alerts.snapshot())
         if self.path.startswith("/debug/trace/"):
             # the span tree of one request/run, looked up by the id the
             # response's X-Request-Id header carried
@@ -3005,6 +3101,10 @@ class _Handler(BaseHTTPRequestHandler):
             # slot occupancy / queue depth / recycle total — the
             # engine's one-glance mirror (gauge + counters ride /metrics)
             body["continuous_batching"] = st._engine.stats()
+        if st.alerts is not None:
+            # firing/pending counts by severity — the pager's one-glance
+            # mirror (the full alert list lives at /debug/alerts)
+            body["alerts"] = st.alerts.summary()
         # the resilience policy at a glance (shed/deadline/cancel/restart
         # counters ride /metrics)
         body["resilience"] = {
