@@ -3,7 +3,7 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast obs-check monitor-check flightrec-check alerts-check perf-check goodput-check serve-identity-check serve-continuous-check paged-check sharded-check resilience-check bench dryrun native dist dist-offline clean
+.PHONY: test test-fast analysis-check obs-check monitor-check flightrec-check alerts-check perf-check goodput-check serve-identity-check serve-continuous-check paged-check sharded-check resilience-check bench dryrun native dist dist-offline clean
 
 test:
 	python -m pytest tests/ -q
@@ -13,8 +13,15 @@ test:
 native:
 	python -c "from tpu_kubernetes import native; assert native.available(), 'native build failed'; print('native runtime OK')"
 
-test-fast:
+test-fast: analysis-check
 	python -m pytest tests/ -q -m "not slow"
+
+# Invariant-analyzer gate: the AST contract passes (closed vocabularies,
+# env contract, concurrency discipline) over the shipped tree. Exits
+# nonzero on any finding not in analysis-baseline.json — which ships
+# EMPTY, and should stay that way (docs/guide/static-analysis.md).
+analysis-check:
+	python -m tpu_kubernetes analyze
 
 # Fast observability smoke: registry/events/tracer/exposition units, the
 # history store (tsdb), the fleet aggregator + SLO suite, plus a live
@@ -151,8 +158,13 @@ sharded-check:
 # deterministic fault-injection harness + chaos matrix (test_faults.py),
 # slot recycling under injected failure, dead-target scrape backoff, and
 # transient terraform retry (docs/guide/serving.md "Resilience").
+# TPU_K8S_LOCKGRAPH=1 arms the lock-order watchdog for the whole run:
+# every threading.Lock the chaos suites allocate is instrumented, and
+# the session fails on any cross-thread lock-acquisition cycle
+# (tpu_kubernetes/analysis/lockgraph.py; tests/conftest.py checks at
+# session end).
 resilience-check:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
+	JAX_PLATFORMS=cpu TPU_K8S_LOCKGRAPH=1 python -m pytest tests/test_resilience.py \
 	  tests/test_faults.py tests/test_executor.py \
 	  "tests/test_serve_continuous.py::test_slot_recycled_after_insert_failure" \
 	  "tests/test_serve_continuous.py::test_token_identity_survives_segment_failure" \

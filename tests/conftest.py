@@ -32,6 +32,32 @@ import pytest
 
 
 @pytest.fixture(scope="session", autouse=True)
+def _lockgraph_watchdog():
+    """Opt-in lock-order watchdog (TPU_K8S_LOCKGRAPH=1, set by
+    `make resilience-check`): instrument every threading.Lock/RLock the
+    suite allocates, build the cross-thread acquisition graph, and fail
+    the session on a cycle — a potential deadlock the chaos matrix
+    exercised without happening to hang (analysis/lockgraph.py)."""
+    from tpu_kubernetes.util.envparse import env_bool
+
+    if not env_bool("TPU_K8S_LOCKGRAPH"):
+        yield
+        return
+    from tpu_kubernetes.analysis import lockgraph
+
+    with lockgraph.watching() as graph:
+        yield
+    report = graph.report()
+    held = [
+        (info["max_hold_s"], name)
+        for name, info in report["locks"].items()
+    ]
+    for hold_s, name in sorted(held, reverse=True)[:5]:
+        print(f"[lockgraph] max hold {hold_s:.6f}s  {name}")
+    graph.check()  # raises LockOrderError on any observed cycle
+
+
+@pytest.fixture(scope="session", autouse=True)
 def _flightrec_default_dir(tmp_path_factory):
     """Serve-server fixtures that don't set TPU_K8S_FLIGHTREC_DIR fall back
     to the recorder's CWD-relative default — which would litter the repo

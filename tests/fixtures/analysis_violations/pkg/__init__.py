@@ -1,0 +1,7 @@
+"""Intentional-violation fixture package for the invariant analyzer.
+
+Every module here commits exactly one instance of a finding code from
+tpu_kubernetes/analysis (tests/test_analysis.py asserts the analyzer
+reports precisely this set and nothing else). Never imported — the
+analyzer is AST-only — and never collected by pytest.
+"""

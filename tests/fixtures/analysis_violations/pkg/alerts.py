@@ -1,0 +1,17 @@
+"""Rule-kind registry with one registered kind; the alerts.d fixture
+references one that is not registered."""
+
+RULE_KINDS: dict = {}
+
+
+def rule_kind(name: str):
+    def deco(fn):
+        RULE_KINDS[name] = fn
+        return fn
+
+    return deco
+
+
+@rule_kind("known_kind")
+class KnownRule:
+    pass

@@ -1,0 +1,11 @@
+"""Env reads: one documented, one not."""
+
+import os
+
+
+def documented() -> str:
+    return os.environ.get("SERVE_FIXTURE_OK", "")
+
+
+def undocumented() -> str:
+    return os.environ.get("SERVE_FIXTURE_UNDOC", "")   # env-undocumented

@@ -1,0 +1,14 @@
+"""Fault-site vocabulary with one entry no code ever fires."""
+
+SITES = frozenset({
+    "good.site",      # fired from firesites.py — no finding
+    "never.fired",    # fault-site-unfired
+})
+
+
+class FaultInjector:
+    def fire(self, site: str) -> None:
+        pass
+
+
+FAULTS = FaultInjector()

@@ -1,0 +1,15 @@
+"""fire() call sites: one valid, one unknown, one dynamic."""
+
+from tests.fixtures.analysis_violations.pkg.faults import FAULTS
+
+
+def ok_path() -> None:
+    FAULTS.fire("good.site")
+
+
+def typo_path() -> None:
+    FAULTS.fire("bogus.site")       # fault-site-unknown
+
+
+def dynamic_path(site: str) -> None:
+    FAULTS.fire(site)               # fault-site-dynamic

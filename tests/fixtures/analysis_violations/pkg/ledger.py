@@ -1,0 +1,13 @@
+"""Closed settlement-class vocabulary (the obs/ledger.py shape)."""
+
+USEFUL = "useful"
+BUBBLE = "bubble"
+CLASSES = (USEFUL, BUBBLE)
+
+
+class Ledger:
+    def settle(self, cls: str, tokens: int = 0) -> None:
+        pass
+
+
+LEDGER = Ledger()

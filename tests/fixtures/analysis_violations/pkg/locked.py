@@ -1,0 +1,21 @@
+"""Lock discipline: one unguarded write, one blocking call under lock."""
+
+import threading
+import time
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def bump(self) -> None:
+        with self._lock:
+            self._count += 1
+
+    def reset_unsafe(self) -> None:
+        self._count = 0                  # lock-unguarded-write
+
+    def slow_tick(self) -> None:
+        with self._lock:
+            time.sleep(0.01)             # lock-blocking-call

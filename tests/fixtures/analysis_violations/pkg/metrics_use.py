@@ -1,0 +1,30 @@
+"""Metric registrations: a scheme violation, non-literal labels, an
+undocumented family, and an unknown ledger settle class."""
+
+from tests.fixtures.analysis_violations.pkg.ledger import LEDGER
+
+
+class Registry:
+    def counter(self, name, help_text="", labelnames=()):
+        pass
+
+    def gauge(self, name, help_text="", labelnames=()):
+        pass
+
+
+REGISTRY = Registry()
+
+DYNAMIC_LABELS = ("a", "b")
+
+BAD_NAME = REGISTRY.counter("serve_bad_name_total")      # metric-name-scheme
+SLOPPY = REGISTRY.gauge(
+    "tpu_ok_gauge", "documented gauge",
+    labelnames=DYNAMIC_LABELS,                           # metric-labels-not-literal
+)
+GHOST = REGISTRY.counter(
+    "tpu_undocumented_total", "missing from the catalog doc",
+)                                                        # metric-undocumented
+
+
+def settle_badly() -> None:
+    LEDGER.settle("mystery-class", 3)                    # ledger-class-unknown

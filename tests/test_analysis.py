@@ -1,0 +1,174 @@
+"""The invariant analyzer: violation fixture coverage, real-tree
+cleanliness, the --json schema contract, and baseline suppression.
+
+The fixture package (tests/fixtures/analysis_violations/) commits
+exactly one violation per finding code; the shipped tree must produce
+none (make analysis-check gates on that with an EMPTY baseline)."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+import tpu_kubernetes
+from tpu_kubernetes import analysis
+from tpu_kubernetes.cli.main import main
+
+FIXTURE_ROOT = Path(__file__).parent / "fixtures" / "analysis_violations"
+REPO_ROOT = Path(tpu_kubernetes.__file__).resolve().parent.parent
+
+ALL_CODES = {
+    "fault-site-unknown",
+    "fault-site-unfired",
+    "fault-site-dynamic",
+    "metric-name-scheme",
+    "metric-labels-not-literal",
+    "metric-unregistered",
+    "metric-undocumented",
+    "ledger-class-unknown",
+    "alert-kind-unknown",
+    "env-undocumented",
+    "env-stale-doc",
+    "lock-unguarded-write",
+    "lock-blocking-call",
+}
+
+
+def test_finding_codes_table_matches_the_fixture_contract():
+    # the docs table (FINDING_CODES) and the fixture suite cover the
+    # same closed set — a new code needs a fixture violation and a row
+    assert set(analysis.FINDING_CODES) == ALL_CODES
+
+
+def test_fixture_reports_exactly_one_of_every_code():
+    findings = analysis.run_analysis(FIXTURE_ROOT)
+    by_code = {}
+    for f in findings:
+        by_code.setdefault(f.code, []).append(f)
+    assert set(by_code) == ALL_CODES, (
+        f"missing: {ALL_CODES - set(by_code)}, "
+        f"extra: {set(by_code) - ALL_CODES}"
+    )
+    dupes = {c: [f"{x.path}:{x.line}" for x in fs]
+             for c, fs in by_code.items() if len(fs) != 1}
+    assert not dupes, f"expected exactly one finding per code: {dupes}"
+
+
+def test_fixture_findings_carry_stable_symbols_and_locations():
+    findings = analysis.run_analysis(FIXTURE_ROOT)
+    by_code = {f.code: f for f in findings}
+    assert by_code["fault-site-unfired"].symbol == "never.fired"
+    assert by_code["fault-site-unknown"].symbol == "bogus.site"
+    assert by_code["metric-unregistered"].symbol == \
+        "tpu_documented_missing_total"
+    assert by_code["metric-undocumented"].symbol == "tpu_undocumented_total"
+    assert by_code["ledger-class-unknown"].symbol == "mystery-class"
+    assert by_code["alert-kind-unknown"].symbol == "mystery_kind"
+    assert by_code["env-undocumented"].symbol == "SERVE_FIXTURE_UNDOC"
+    assert by_code["env-stale-doc"].symbol == "SERVE_FIXTURE_STALE"
+    assert by_code["lock-unguarded-write"].symbol == "Engine._count"
+    for f in findings:
+        assert f.path and not f.path.startswith("/"), f
+        assert f.line >= 1, f
+
+
+def test_shipped_tree_is_clean_with_no_baseline():
+    # the make analysis-check acceptance criterion, as a unit: every
+    # pass over the real repo, zero findings, no suppressions consumed
+    findings = analysis.run_analysis(REPO_ROOT)
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line}: {f.code} [{f.symbol}]" for f in findings
+    )
+
+
+def test_cli_analyze_exits_zero_on_shipped_tree(capsys):
+    assert main(["analyze"]) == 0
+    assert "analysis clean" in capsys.readouterr().out
+
+
+def test_cli_analyze_fails_on_fixture_with_rendered_findings(capsys):
+    rc = main(["analyze", "--root", str(FIXTURE_ROOT)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    for code in ALL_CODES:
+        assert code in out
+    # compiler-style path:line: prefixes, so terminals link them
+    assert "pkg/locked.py:" in out
+
+
+def test_cli_json_schema_contract(capsys):
+    rc = main(["analyze", "--json", "--root", str(FIXTURE_ROOT)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert set(payload) == {
+        "version", "root", "passes", "ok", "counts", "findings",
+        "baselined",
+    }
+    assert payload["version"] == analysis.JSON_SCHEMA_VERSION
+    assert payload["ok"] is False
+    assert payload["passes"] == sorted(analysis.PASS_NAMES)
+    assert payload["baselined"] == []
+    for f in payload["findings"]:
+        assert set(f) == {"code", "pass", "path", "line", "message",
+                          "symbol"}
+        assert f["pass"] in analysis.PASS_NAMES
+    assert sum(payload["counts"].values()) == len(payload["findings"])
+    assert set(payload["counts"]) == ALL_CODES
+
+
+def test_cli_pass_filter_runs_only_that_pass(capsys):
+    rc = main(["analyze", "--json", "--root", str(FIXTURE_ROOT),
+               "--pass", "env"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["passes"] == ["env"]
+    assert set(payload["counts"]) == {"env-undocumented", "env-stale-doc"}
+
+
+def test_baseline_suppresses_by_symbol_not_line(tmp_path, capsys):
+    findings = analysis.run_analysis(FIXTURE_ROOT)
+    baseline = tmp_path / "baseline.json"
+    analysis.write_baseline(baseline, findings)
+    rc = main(["analyze", "--json", "--root", str(FIXTURE_ROOT),
+               "--baseline", str(baseline)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["ok"] is True
+    assert payload["findings"] == []
+    assert len(payload["baselined"]) == len(findings)
+    # entries key on (code, path, symbol) — line drift must not
+    # invalidate a suppression
+    entries = json.loads(baseline.read_text())["suppress"]
+    assert all(set(e) == {"code", "path", "symbol"} for e in entries)
+
+
+@pytest.mark.parametrize("content, fragment", [
+    ('{"suppress": "not-a-list"}', "suppress"),
+    ('{bad json', "not valid JSON"),
+    ('[1, 2]', "JSON object"),
+])
+def test_malformed_baseline_is_a_loud_error(tmp_path, capsys, content,
+                                            fragment):
+    bad = tmp_path / "baseline.json"
+    bad.write_text(content)
+    rc = main(["analyze", "--root", str(FIXTURE_ROOT),
+               "--baseline", str(bad)])
+    assert rc == 2
+    assert fragment in capsys.readouterr().err
+
+
+def test_shipped_baseline_file_is_empty():
+    data = json.loads((REPO_ROOT / "analysis-baseline.json").read_text())
+    assert data["suppress"] == []
+
+
+@pytest.mark.parametrize("name", ["contracts", "env", "concurrency"])
+def test_each_pass_runs_standalone_on_the_real_tree(name):
+    project = analysis.Project.discover(REPO_ROOT)
+    assert analysis.run_pass(project, name) == []
+
+
+def test_unknown_pass_is_a_project_error():
+    project = analysis.Project.discover(REPO_ROOT)
+    with pytest.raises(analysis.ProjectError):
+        analysis.run_pass(project, "nope")

@@ -1,0 +1,75 @@
+"""The env-parsing chokepoint (util/envparse.py).
+
+The regression that motivated it: a malformed knob value used to raise
+ValueError at server startup. Through the chokepoint a bad value falls
+back to the documented default with a warning on stderr — a typo'd
+SERVE_BATCH must never take a serving pod down.
+"""
+
+import pytest
+
+from tpu_kubernetes.util.envparse import (
+    FALSY,
+    env_bool,
+    env_float,
+    env_int,
+    env_str,
+)
+
+
+def test_bad_int_falls_back_to_default_with_warning(capsys):
+    env = {"SERVE_BATCH": "eight"}
+    assert env_int("SERVE_BATCH", 8, env=env) == 8
+    err = capsys.readouterr().err
+    assert "SERVE_BATCH" in err
+    assert "'eight'" in err
+    assert "default 8" in err
+
+
+def test_bad_float_falls_back_to_default_with_warning(capsys):
+    env = {"SERVE_TEMPERATURE": "warm"}
+    assert env_float("SERVE_TEMPERATURE", 0.7, env=env) == 0.7
+    assert "SERVE_TEMPERATURE" in capsys.readouterr().err
+
+
+def test_good_values_parse_silently(capsys):
+    env = {"A": "42", "B": "0.25", "C": "text"}
+    assert env_int("A", 0, env=env) == 42
+    assert env_float("B", 0.0, env=env) == 0.25
+    assert env_str("C", "d", env=env) == "text"
+    assert capsys.readouterr().err == ""
+
+
+def test_unset_and_empty_mean_default():
+    for env in ({}, {"K": ""}, {"K": "   "}):
+        assert env_int("K", 7, env=env) == 7
+        assert env_float("K", 1.5, env=env) == 1.5
+    assert env_str("K", "fallback", env={}) == "fallback"
+
+
+def test_int_accepts_surrounding_whitespace():
+    assert env_int("K", 0, env={"K": " 12 "}) == 12
+
+
+@pytest.mark.parametrize("raw", FALSY)
+def test_bool_falsy_table(raw):
+    assert env_bool("K", True, env={"K": raw}) is False
+
+
+@pytest.mark.parametrize("raw", ["1", "true", "TRUE", "yes", "on", "x"])
+def test_bool_truthy_values(raw):
+    assert env_bool("K", False, env={"K": raw}) is True
+
+
+def test_bool_unset_uses_default():
+    assert env_bool("K", env={}) is False
+    assert env_bool("K", True, env={}) is True
+    assert env_bool("K", True, env={"K": "FALSE "}) is False
+
+
+def test_none_env_reads_process_environment(monkeypatch, capsys):
+    monkeypatch.setenv("TPU_K8S_ENVPARSE_TEST", "31")
+    assert env_int("TPU_K8S_ENVPARSE_TEST", 0) == 31
+    monkeypatch.setenv("TPU_K8S_ENVPARSE_TEST", "not-a-number")
+    assert env_int("TPU_K8S_ENVPARSE_TEST", 5) == 5
+    assert "TPU_K8S_ENVPARSE_TEST" in capsys.readouterr().err

@@ -1,0 +1,359 @@
+"""Repo-native invariant analyzer: contract lint + concurrency passes.
+
+The stack rests on closed vocabularies and concurrency discipline that
+review alone cannot enforce: the fault-site vocabulary (obs/faults.py
+``SITES``), the ``tpu_*`` metric naming scheme and the observability-doc
+metric catalog, the ledger settlement classes (obs/ledger.py
+``CLASSES``), the alert-rule kind registry (obs/alerts.py
+``RULE_KINDS``), the ``TPU_K8S_*``/``SERVE_*``/``SERVER_*`` env
+contract, and the hand-audited ``with self._lock`` regions guarding the
+scheduler / page pool / aggregator / notifier. Each of those has
+regressed silently at least once (the spec_totals lock fix, SLO
+flapping, counter-reset clamps); this package makes them *mechanical*:
+
+* **AST passes** (:mod:`contracts`, :mod:`envcontract`,
+  :mod:`concurrency`) lint the package source without importing it —
+  no jax, no side effects, fast enough for a pre-commit hook.
+* **A runtime lock-order watchdog** (:mod:`lockgraph`) instruments
+  ``threading.Lock`` during the chaos/resilience suites, builds the
+  cross-thread lock-acquisition graph, and fails the run on a cycle.
+
+Surfaces: ``tpu-kubernetes analyze [--json] [--pass NAME]`` and
+``make analysis-check`` (exits non-zero on findings not in the
+committed baseline, ``analysis-baseline.json`` — intentionally empty on
+the shipped tree). docs/guide/static-analysis.md documents every
+finding code and the baseline workflow.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Iterable
+
+# one entry per finding code — docs/guide/static-analysis.md mirrors
+# this table; the fixture suite asserts each code is producible
+FINDING_CODES = {
+    "fault-site-unknown":
+        "FAULTS.fire() literal not in the obs/faults.py SITES vocabulary",
+    "fault-site-unfired":
+        "SITES entry with no FAULTS.fire() call site anywhere in the "
+        "package (a chaos site that can never fire is a lie)",
+    "fault-site-dynamic":
+        "FAULTS.fire() with a non-literal site (the closed vocabulary "
+        "cannot be checked through a variable)",
+    "metric-name-scheme":
+        "registered metric name is dynamic or does not match the "
+        "tpu_[a-z0-9_]* naming scheme",
+    "metric-labels-not-literal":
+        "labelnames= is not a literal tuple/list of string literals",
+    "metric-unregistered":
+        "metric named in the docs tables / alerts.d rules / monitor "
+        "columns resolves to no registered metric",
+    "metric-undocumented":
+        "registered metric missing from the "
+        "docs/guide/observability.md catalog",
+    "ledger-class-unknown":
+        "ledger settle() literal not in the obs/ledger.py CLASSES "
+        "vocabulary",
+    "alert-kind-unknown":
+        "alerts.d rule kind not registered via @rule_kind",
+    "env-undocumented":
+        "TPU_K8S_*/SERVE_*/SERVER_* env read with no docs-table or "
+        "module-docstring row",
+    "env-stale-doc":
+        "documented env var that nothing in the package or tests reads",
+    "lock-unguarded-write":
+        "write to lock-guarded shared state outside a `with self._lock` "
+        "region",
+    "lock-blocking-call":
+        "blocking call (sleep / urlopen / subprocess / terraform exec) "
+        "made while a lock is held",
+}
+
+PASS_NAMES = ("contracts", "env", "concurrency")
+
+JSON_SCHEMA_VERSION = 1
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer hit. ``symbol`` is the stable anchor (site name,
+    metric name, env var, ``Class.attr``) the baseline matches on, so a
+    baselined exception survives line drift."""
+
+    code: str
+    path: str          # repo-root-relative, forward slashes
+    line: int
+    symbol: str
+    message: str
+    pass_name: str = ""
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.code, self.path, self.symbol)
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code,
+            "pass": self.pass_name,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+        }
+
+
+class ProjectError(RuntimeError):
+    pass
+
+
+@dataclass
+class Project:
+    """What the passes scan: a package tree plus its doc surfaces.
+
+    ``discover()`` resolves the real repo layout; tests point it at the
+    violation fixture tree (same conventions, miniature scale)."""
+
+    root: Path
+    pkg: Path
+    doc_files: list[Path] = field(default_factory=list)
+    metric_doc: Path | None = None      # the metric/env catalog doc
+    alert_files: list[Path] = field(default_factory=list)
+    tests_dir: Path | None = None
+    _sources: dict[Path, ast.Module] | None = None
+
+    @classmethod
+    def discover(cls, root: str | Path) -> "Project":
+        root = Path(root).resolve()
+        pkg = root / "tpu_kubernetes"
+        if not (pkg / "__init__.py").is_file():
+            candidates = sorted(
+                p.parent for p in root.glob("*/__init__.py")
+                if p.parent.name not in ("tests", "docs")
+            )
+            if not candidates:
+                raise ProjectError(f"no python package under {root}")
+            pkg = candidates[0]
+        docs = sorted((root / "docs").rglob("*.md")) \
+            if (root / "docs").is_dir() else []
+        readme = root / "README.md"
+        if readme.is_file():
+            docs.append(readme)
+        metric_doc = next(
+            (d for d in docs if d.name == "observability.md"), None
+        )
+        alerts_dir = root / "examples" / "alerts.d"
+        alert_files = sorted(alerts_dir.glob("*.json")) \
+            if alerts_dir.is_dir() else []
+        tests_dir = root / "tests" if (root / "tests").is_dir() else None
+        return cls(root=root, pkg=pkg, doc_files=docs,
+                   metric_doc=metric_doc, alert_files=alert_files,
+                   tests_dir=tests_dir)
+
+    # -- source access ----------------------------------------------------
+
+    def py_files(self) -> list[Path]:
+        return sorted(
+            p for p in self.pkg.rglob("*.py")
+            if "__pycache__" not in p.parts
+        )
+
+    def parse(self, path: Path) -> ast.Module:
+        if self._sources is None:
+            self._sources = {}
+        tree = self._sources.get(path)
+        if tree is None:
+            tree = ast.parse(
+                path.read_text(encoding="utf-8"), filename=str(path)
+            )
+            self._sources[path] = tree
+        return tree
+
+    def rel(self, path: Path) -> str:
+        try:
+            return path.resolve().relative_to(self.root).as_posix()
+        except ValueError:
+            return path.as_posix()
+
+    def doc_text(self) -> str:
+        return "\n".join(
+            p.read_text(encoding="utf-8") for p in self.doc_files
+        )
+
+
+# -- pass registry ---------------------------------------------------------
+
+def run_pass(project: Project, name: str) -> list[Finding]:
+    from tpu_kubernetes.analysis import concurrency, contracts, envcontract
+
+    table: dict[str, Callable[[Project], list[Finding]]] = {
+        "contracts": contracts.run,
+        "env": envcontract.run,
+        "concurrency": concurrency.run,
+    }
+    if name not in table:
+        raise ProjectError(
+            f"unknown pass {name!r} (one of {list(PASS_NAMES)})"
+        )
+    findings = table[name](project)
+    return [
+        Finding(f.code, f.path, f.line, f.symbol, f.message, name)
+        for f in findings
+    ]
+
+
+def run_analysis(root: str | Path, passes: Iterable[str] | None = None,
+                 ) -> list[Finding]:
+    """Run the requested passes (default: all) over ``root`` and return
+    findings sorted by (path, line, code)."""
+    project = Project.discover(root)
+    out: list[Finding] = []
+    for name in (passes or PASS_NAMES):
+        out.extend(run_pass(project, name))
+    return sorted(out, key=lambda f: (f.path, f.line, f.code, f.symbol))
+
+
+# -- baseline --------------------------------------------------------------
+
+BASELINE_NAME = "analysis-baseline.json"
+
+
+def load_baseline(path: str | Path) -> set[tuple[str, str, str]]:
+    """The committed exception list: ``{"suppress": [{code, path,
+    symbol}, ...]}``. Missing file = empty baseline (the shipped state);
+    a malformed file is a loud error, not a silent all-clear."""
+    p = Path(path)
+    if not p.is_file():
+        return set()
+    try:
+        data = json.loads(p.read_text(encoding="utf-8"))
+    except json.JSONDecodeError as exc:
+        raise ProjectError(f"{p}: not valid JSON: {exc}") from exc
+    if not isinstance(data, dict):
+        raise ProjectError(f"{p}: baseline must be a JSON object")
+    entries = data.get("suppress", [])
+    if not isinstance(entries, list):
+        raise ProjectError(f"{p}: 'suppress' must be a list")
+    out = set()
+    for e in entries:
+        try:
+            out.add((e["code"], e["path"], e["symbol"]))
+        except (TypeError, KeyError) as exc:
+            raise ProjectError(
+                f"{p}: baseline entries need code/path/symbol ({e!r})"
+            ) from exc
+    return out
+
+
+def write_baseline(path: str | Path, findings: list[Finding]) -> None:
+    entries = [
+        {"code": f.code, "path": f.path, "symbol": f.symbol}
+        for f in findings
+    ]
+    Path(path).write_text(
+        json.dumps({"version": 1, "suppress": entries},
+                   indent=2, sort_keys=True) + "\n",
+        encoding="utf-8",
+    )
+
+
+def split_baselined(findings: list[Finding],
+                    baseline: set[tuple[str, str, str]],
+                    ) -> tuple[list[Finding], list[Finding]]:
+    """(new, baselined) — baselined findings are reported but do not
+    fail the gate."""
+    new = [f for f in findings if f.key() not in baseline]
+    old = [f for f in findings if f.key() in baseline]
+    return new, old
+
+
+def report_json(findings: list[Finding], baselined: list[Finding],
+                root: str, passes: Iterable[str]) -> dict:
+    """The ``analyze --json`` payload — a stable schema monitor-style
+    tooling consumes (tests/test_analysis.py pins it)."""
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.code] = counts.get(f.code, 0) + 1
+    return {
+        "version": JSON_SCHEMA_VERSION,
+        "root": root,
+        "passes": sorted(passes),
+        "ok": not findings,
+        "counts": counts,
+        "findings": [f.to_dict() for f in findings],
+        "baselined": [f.to_dict() for f in baselined],
+    }
+
+
+def render_findings(findings: list[Finding], baselined: list[Finding],
+                    ) -> str:
+    """Human rendering: one ``path:line: code [symbol] message`` line
+    per finding, compiler style, so terminals and CI logs link it."""
+    lines = []
+    for f in findings:
+        lines.append(
+            f"{f.path}:{f.line}: {f.code} [{f.symbol}] {f.message}"
+        )
+    for f in baselined:
+        lines.append(
+            f"{f.path}:{f.line}: {f.code} [{f.symbol}] (baselined) "
+            f"{f.message}"
+        )
+    if not findings:
+        lines.append(
+            "analysis clean"
+            + (f" ({len(baselined)} baselined)" if baselined else "")
+        )
+    else:
+        lines.append(f"{len(findings)} finding(s)")
+    return "\n".join(lines) + "\n"
+
+
+# -- shared AST helpers (used by every pass) -------------------------------
+
+def str_const(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def literal_str_seq(node: ast.AST) -> list[str] | None:
+    """A literal tuple/list/set of string constants, or None. Unwraps
+    ``frozenset({...})`` / ``set(...)`` / ``tuple(...)`` calls."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("frozenset", "set", "tuple", "list") \
+            and len(node.args) == 1 and not node.keywords:
+        node = node.args[0]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for el in node.elts:
+            s = str_const(el)
+            if s is None:
+                return None
+            out.append(s)
+        return out
+    return None
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target, best-effort: ``time.sleep`` →
+    'time.sleep', ``self._lock.acquire`` → 'self._lock.acquire'."""
+    parts: list[str] = []
+    cur: ast.AST = node.func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    elif isinstance(cur, ast.Call):
+        parts.append("()")
+    return ".".join(reversed(parts))
+
+
+ENV_PREFIX_RE = re.compile(r"^(?:TPU_K8S_|SERVE_|SERVER_)[A-Z0-9_]+$")
+METRIC_RE = re.compile(r"^tpu_[a-z0-9_]+$")
+METRIC_TOKEN_RE = re.compile(r"\btpu_[a-z0-9_]+\b")
+ENV_TOKEN_RE = re.compile(r"\b(?:TPU_K8S_|SERVE_|SERVER_)[A-Z0-9_]+\b")

@@ -1,0 +1,251 @@
+"""Concurrency-discipline lint: unguarded shared-state writes and
+blocking calls under a lock.
+
+Scope is self-selecting: any class that owns a ``threading.Lock`` /
+``RLock`` attribute (``self._lock = threading.Lock()``) is treated as
+shared-state, and the attributes it ever writes *under* that lock
+become the guarded set. Two findings:
+
+* `lock-unguarded-write` — a guarded attribute written outside a
+  ``with self.<lock>`` region. Exemptions keep the pass honest about
+  the codebase's real discipline:
+
+  - ``__init__`` (construction happens-before publication);
+  - *lock-context methods*: a method whose every intra-class call site
+    is under the lock, inside ``__init__``, or inside another
+    lock-context method (fixpoint). This is the
+    ``TokenLedger._zero`` shape — called unlocked from ``__init__``
+    and under the lock from ``reset()`` — which is correct and must
+    not be flagged;
+  - an explicit ``# lint: unlocked-ok`` pragma on the write line, for
+    documented single-owner state (the escape hatch is visible in the
+    diff, unlike a baseline entry).
+
+* `lock-blocking-call` — ``time.sleep`` / ``urlopen`` /
+  ``subprocess.*`` (the terraform exec path) lexically inside a
+  ``with self.<lock>`` block: the scheduler-stall bug class, where one
+  slow I/O under the engine lock freezes every request thread.
+
+Nested functions (thread bodies, callbacks) reset the lock context —
+a ``def`` under a ``with`` runs later, not under the lock.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tpu_kubernetes.analysis import Finding, Project, call_name
+
+LOCK_FACTORIES = ("Lock", "RLock", "InstrumentedLock")
+PRAGMA = "lint: unlocked-ok"
+
+
+def run(project: Project) -> list[Finding]:
+    out: list[Finding] = []
+    for path in project.py_files():
+        tree = project.parse(path)
+        lines = path.read_text(encoding="utf-8").splitlines()
+        rel = project.rel(path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(_check_class(node, rel, lines))
+    return out
+
+
+@dataclass
+class _Write:
+    attr: str
+    line: int
+    under: bool
+    method: str
+
+
+@dataclass
+class _Call:
+    name: str
+    line: int
+    under: bool
+    method: str
+
+
+@dataclass
+class _Scan:
+    writes: list[_Write] = field(default_factory=list)
+    calls: list[_Call] = field(default_factory=list)
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set[str]:
+    """Attributes holding lock objects: ``self.X = threading.Lock()``
+    in any method, or a class-level ``X = Lock()``."""
+    attrs: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Call)
+                and call_name(node.value).split(".")[-1] in LOCK_FACTORIES):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                attrs.add(t.attr)
+            elif isinstance(t, ast.Name) and node in cls.body:
+                attrs.add(t.id)
+    return attrs
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _write_targets(node: ast.AST):
+    """Yield (attr, line) for self-attribute write targets, including
+    ``self.x[...] = ...`` item writes (the dict/deque counters are the
+    shared state that matters most)."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for el in node.elts:
+            yield from _write_targets(el)
+        return
+    attr = _self_attr(node)
+    if attr is not None:
+        yield attr, node.lineno
+        return
+    if isinstance(node, ast.Subscript):
+        attr = _self_attr(node.value)
+        if attr is not None:
+            yield attr, node.lineno
+
+
+def _is_lock_ctx(item: ast.withitem, locks: set[str]) -> bool:
+    expr = item.context_expr
+    attr = _self_attr(expr)
+    if attr is None and isinstance(expr, ast.Name):
+        attr = expr.id
+    return attr in locks
+
+
+def _scan_method(method: ast.FunctionDef, locks: set[str]) -> _Scan:
+    scan = _Scan()
+
+    def visit(node: ast.AST, under: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # deferred execution: a nested def is NOT under the lock
+            for child in ast.iter_child_nodes(node):
+                visit(child, False)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = under or any(
+                _is_lock_ctx(i, locks) for i in node.items
+            )
+            for i in node.items:
+                visit(i, under)
+            for child in node.body:
+                visit(child, inner)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                for attr, line in _write_targets(t):
+                    scan.writes.append(
+                        _Write(attr, line, under, method.name)
+                    )
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                for attr, line in _write_targets(t):
+                    scan.writes.append(
+                        _Write(attr, line, under, method.name)
+                    )
+        if isinstance(node, ast.Call):
+            scan.calls.append(
+                _Call(call_name(node), node.lineno, under, method.name)
+            )
+        for child in ast.iter_child_nodes(node):
+            visit(child, under)
+
+    for child in method.body:
+        visit(child, False)
+    return scan
+
+
+def _blocking(name: str) -> bool:
+    return (
+        name == "time.sleep"
+        or name.endswith(".urlopen") or name == "urlopen"
+        or name.startswith("subprocess.")
+    )
+
+
+def _check_class(cls: ast.ClassDef, rel: str,
+                 lines: list[str]) -> list[Finding]:
+    locks = _lock_attrs(cls)
+    if not locks:
+        return []
+    methods = [
+        n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    scans = {m.name: _scan_method(m, locks) for m in methods}
+
+    guarded: set[str] = set()
+    for scan in scans.values():
+        for w in scan.writes:
+            if w.under and w.attr not in locks:
+                guarded.add(w.attr)
+
+    # lock-context fixpoint: a method all of whose intra-class call
+    # sites are under the lock / in __init__ / in a lock-context method
+    sites: dict[str, list[_Call]] = {m.name: [] for m in methods}
+    for scan in scans.values():
+        for c in scan.calls:
+            parts = c.name.split(".")
+            if len(parts) == 2 and parts[0] == "self" \
+                    and parts[1] in sites:
+                sites[parts[1]].append(c)
+    lock_ctx: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, callers in sites.items():
+            if name in lock_ctx or name == "__init__" or not callers:
+                continue
+            if all(
+                c.under or c.method == "__init__" or c.method in lock_ctx
+                for c in callers
+            ):
+                lock_ctx.add(name)
+                changed = True
+
+    out: list[Finding] = []
+    for scan in scans.values():
+        for w in scan.writes:
+            if w.under or w.attr not in guarded:
+                continue
+            if w.method == "__init__" or w.method in lock_ctx:
+                continue
+            src = lines[w.line - 1] if w.line <= len(lines) else ""
+            if PRAGMA in src:
+                continue
+            out.append(Finding(
+                "lock-unguarded-write", rel, w.line,
+                f"{cls.name}.{w.attr}",
+                f"{cls.name}.{w.attr} is written under "
+                f"self.{sorted(locks)[0]} elsewhere but not here "
+                f"(method {w.method})",
+            ))
+        for c in scan.calls:
+            if c.under and _blocking(c.name):
+                out.append(Finding(
+                    "lock-blocking-call", rel, c.line,
+                    f"{cls.name}.{c.method}",
+                    f"blocking call {c.name}() while holding a lock in "
+                    f"{cls.name}.{c.method} — every other thread on "
+                    "this lock stalls behind the I/O",
+                ))
+    return out

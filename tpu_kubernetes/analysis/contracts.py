@@ -1,0 +1,388 @@
+"""Closed-vocabulary contract lint: fault sites, metrics, ledger
+classes, alert-rule kinds.
+
+Pure AST + text scanning — the package is never imported. Each check
+mirrors a vocabulary the runtime enforces loudly at one end only; this
+pass closes the other end:
+
+* ``FAULTS.fire("<site>")`` literals vs the ``SITES`` frozenset in
+  obs/faults.py (`fault-site-unknown`, `fault-site-unfired`,
+  `fault-site-dynamic`). configure() rejects an unknown site at arm
+  time, but nothing notices a site that exists only in the set — a
+  chaos matrix entry that can never fire.
+* ``REGISTRY.counter/gauge/histogram`` registrations must use a literal
+  ``tpu_[a-z0-9_]+`` name and literal label tuples
+  (`metric-name-scheme`, `metric-labels-not-literal`); every metric the
+  observability guide tables, examples/alerts.d rules, or monitor
+  columns reference must resolve to a registration
+  (`metric-unregistered`), and every registration must appear in the
+  guide catalog (`metric-undocumented`).
+* ``LEDGER.settle("<class>")`` literals vs obs/ledger.py ``CLASSES``
+  (`ledger-class-unknown`).
+* alerts.d ``"kind"`` values vs the ``@rule_kind`` registry
+  (`alert-kind-unknown`). build_rule() rejects unknown kinds at load
+  time; this catches them before a rule file ships.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from pathlib import Path
+
+from tpu_kubernetes.analysis import (
+    METRIC_RE,
+    METRIC_TOKEN_RE,
+    Finding,
+    Project,
+    call_name,
+    literal_str_seq,
+    str_const,
+)
+
+METRIC_METHODS = ("counter", "gauge", "histogram")
+
+
+def run(project: Project) -> list[Finding]:
+    out: list[Finding] = []
+    out.extend(_check_fault_sites(project))
+    out.extend(_check_metrics(project))
+    out.extend(_check_ledger_classes(project))
+    out.extend(_check_alert_kinds(project))
+    return out
+
+
+# -- fault sites -----------------------------------------------------------
+
+def _module_str_set(project: Project, var: str,
+                    filename: str) -> tuple[Path | None, int, set[str]]:
+    """Find the module-level ``var = frozenset({...})`` literal in the
+    package file named ``filename``. Returns (path, line, values)."""
+    for path in project.py_files():
+        if path.name != filename:
+            continue
+        for node in project.parse(path).body:
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == var
+                for t in node.targets
+            ):
+                vals = literal_str_seq(node.value)
+                if vals is not None:
+                    return path, node.lineno, set(vals)
+    return None, 0, set()
+
+
+def _fire_calls(project: Project):
+    """Yield (path, call) for every ``<something>FAULTS.fire(...)`` /
+    ``faults.fire(...)`` call in the package."""
+    for path in project.py_files():
+        for node in ast.walk(project.parse(path)):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if not name.endswith(".fire"):
+                continue
+            recv = name[: -len(".fire")]
+            if "fault" in recv.lower():
+                yield path, node
+
+
+def _check_fault_sites(project: Project) -> list[Finding]:
+    sites_path, sites_line, sites = _module_str_set(
+        project, "SITES", "faults.py"
+    )
+    if sites_path is None:
+        return []  # nothing to check against (not a faults-bearing tree)
+    out: list[Finding] = []
+    fired: set[str] = set()
+    for path, call in _fire_calls(project):
+        if not call.args:
+            continue
+        site = str_const(call.args[0])
+        if site is None:
+            out.append(Finding(
+                "fault-site-dynamic", project.rel(path), call.lineno,
+                call_name(call),
+                "fire() with a non-literal site — the closed SITES "
+                "vocabulary cannot be checked through a variable",
+            ))
+            continue
+        fired.add(site)
+        if site not in sites:
+            out.append(Finding(
+                "fault-site-unknown", project.rel(path), call.lineno,
+                site,
+                f"fire({site!r}) is not in the SITES vocabulary "
+                f"({project.rel(sites_path)})",
+            ))
+    for site in sorted(sites - fired):
+        out.append(Finding(
+            "fault-site-unfired", project.rel(sites_path), sites_line,
+            site,
+            f"SITES entry {site!r} has no fire() call site — a chaos "
+            "site that can never fire tests nothing",
+        ))
+    return out
+
+
+# -- metrics ---------------------------------------------------------------
+
+def _registrations(project: Project):
+    """Yield (path, call, name_or_None) for every
+    ``<registry>.counter/gauge/histogram(...)`` call. ``name`` resolves
+    literals and the ``metric``-parameter-default idiom (PhaseProfiler
+    takes ``metric: str = "tpu_..."`` and registers through the
+    variable); None means genuinely dynamic."""
+    for path in project.py_files():
+        tree = project.parse(path)
+        # parameter defaults named like the first arg they flow into
+        param_defaults: dict[str, str] = {}
+        for fn in ast.walk(tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = fn.args
+                pos = args.posonlyargs + args.args
+                defaults = args.defaults
+                for a, d in zip(pos[len(pos) - len(defaults):], defaults):
+                    s = str_const(d)
+                    if s is not None:
+                        param_defaults[a.arg] = s
+                for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                    s = str_const(d) if d is not None else None
+                    if s is not None:
+                        param_defaults[a.arg] = s
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in METRIC_METHODS):
+                continue
+            name_node = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    name_node = kw.value
+            if name_node is None:
+                continue
+            name = str_const(name_node)
+            if name is None and isinstance(name_node, ast.Name):
+                name = param_defaults.get(name_node.id)
+            yield path, node, name
+
+
+def _referenced_metrics(project: Project) -> dict[str, tuple[str, int]]:
+    """Metric names the outside surfaces point at → (where, line).
+    Sources: the observability guide tables, alerts.d rule files, and
+    module-level column constants in monitor.py."""
+    refs: dict[str, tuple[str, int]] = {}
+    if project.metric_doc is not None:
+        rel = project.rel(project.metric_doc)
+        text = project.metric_doc.read_text(encoding="utf-8")
+        for i, line in enumerate(text.splitlines(), 1):
+            for tok in METRIC_TOKEN_RE.findall(line):
+                refs.setdefault(tok, (rel, i))
+    for path in project.alert_files:
+        rel = project.rel(path)
+        data = json.loads(path.read_text(encoding="utf-8"))
+        for tok in _json_strings(data):
+            if METRIC_RE.match(tok):
+                refs.setdefault(tok, (rel, 1))
+    for path in project.py_files():
+        if path.name != "monitor.py":
+            continue
+        rel = project.rel(path)
+        for node in project.parse(path).body:
+            if isinstance(node, ast.Assign):
+                s = str_const(node.value)
+                if s is not None and METRIC_RE.match(s):
+                    refs.setdefault(s, (rel, node.lineno))
+    return refs
+
+
+def _json_strings(data):
+    if isinstance(data, str):
+        yield data
+    elif isinstance(data, dict):
+        for v in data.values():
+            yield from _json_strings(v)
+    elif isinstance(data, list):
+        for v in data:
+            yield from _json_strings(v)
+
+
+def _indirect_registrations(project: Project) -> dict[str, tuple[str, int]]:
+    """Literal ``metric="tpu_..."`` keyword arguments at arbitrary call
+    sites — the PhaseProfiler idiom, where the constructor registers the
+    family through its parameter. These count as registered (and as
+    needing documentation) but aren't registration calls themselves."""
+    out: dict[str, tuple[str, int]] = {}
+    for path in project.py_files():
+        rel = project.rel(path)
+        for node in ast.walk(project.parse(path)):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in METRIC_METHODS:
+                continue  # direct registrations handled elsewhere
+            for kw in node.keywords:
+                if kw.arg == "metric":
+                    s = str_const(kw.value)
+                    if s is not None and METRIC_RE.match(s):
+                        out.setdefault(s, (rel, node.lineno))
+    return out
+
+
+def _check_metrics(project: Project) -> list[Finding]:
+    out: list[Finding] = []
+    registered: set[str] = set()
+    reg_sites: dict[str, tuple[str, int]] = {}
+    for name, site in _indirect_registrations(project).items():
+        registered.add(name)
+        reg_sites.setdefault(name, site)
+    for path, call, name in _registrations(project):
+        rel = project.rel(path)
+        if name is None:
+            out.append(Finding(
+                "metric-name-scheme", rel, call.lineno, call_name(call),
+                "metric registered through a dynamic name — the catalog "
+                "cross-check needs a literal (or a literal parameter "
+                "default)",
+            ))
+        else:
+            registered.add(name)
+            reg_sites.setdefault(name, (rel, call.lineno))
+            if not METRIC_RE.match(name):
+                out.append(Finding(
+                    "metric-name-scheme", rel, call.lineno, name,
+                    f"metric name {name!r} does not match the "
+                    "tpu_[a-z0-9_]+ scheme",
+                ))
+        for kw in call.keywords:
+            if kw.arg == "labelnames" \
+                    and literal_str_seq(kw.value) is None:
+                out.append(Finding(
+                    "metric-labels-not-literal", rel, call.lineno,
+                    name or call_name(call),
+                    "labelnames= must be a literal tuple of string "
+                    "literals (label cardinality is part of the metric "
+                    "contract)",
+                ))
+    refs = _referenced_metrics(project)
+    refs.pop(project.pkg.name, None)  # 'tpu_kubernetes' in doc paths
+    for name in sorted(set(refs) - registered):
+        where, line = refs[name]
+        out.append(Finding(
+            "metric-unregistered", where, line, name,
+            f"{name!r} is referenced here but no "
+            "REGISTRY.counter/gauge/histogram registers it",
+        ))
+    if project.metric_doc is not None:
+        doc_tokens = set(METRIC_TOKEN_RE.findall(
+            project.metric_doc.read_text(encoding="utf-8")
+        ))
+        # scheme violations already got their own finding — don't also
+        # demand documentation for a name that must be renamed anyway
+        for name in sorted(n for n in registered - doc_tokens
+                           if METRIC_RE.match(n)):
+            where, line = reg_sites[name]
+            out.append(Finding(
+                "metric-undocumented", where, line, name,
+                f"{name!r} is registered but missing from the "
+                f"{project.rel(project.metric_doc)} catalog",
+            ))
+    return out
+
+
+# -- ledger classes --------------------------------------------------------
+
+def _ledger_classes(project: Project) -> set[str]:
+    """The CLASSES tuple in ledger.py — elements are module-level
+    constants (USEFUL = "useful"; CLASSES = (USEFUL, ...)), so resolve
+    Name elements through the module's constant assignments."""
+    for path in project.py_files():
+        if path.name != "ledger.py":
+            continue
+        tree = project.parse(path)
+        consts: dict[str, str] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                s = str_const(node.value)
+                if s is not None:
+                    consts[node.targets[0].id] = s
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "CLASSES"
+                for t in node.targets
+            ) and isinstance(node.value, (ast.Tuple, ast.List)):
+                vals = set()
+                for el in node.value.elts:
+                    s = str_const(el)
+                    if s is None and isinstance(el, ast.Name):
+                        s = consts.get(el.id)
+                    if s is not None:
+                        vals.add(s)
+                return vals
+    return set()
+
+
+def _check_ledger_classes(project: Project) -> list[Finding]:
+    classes = _ledger_classes(project)
+    if not classes:
+        return []
+    out: list[Finding] = []
+    for path in project.py_files():
+        for node in ast.walk(project.parse(path)):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if not (name.endswith(".settle")
+                    or name.endswith(".settle_request")):
+                continue
+            if not node.args:
+                continue
+            cls = str_const(node.args[0])
+            if cls is not None and cls not in classes:
+                out.append(Finding(
+                    "ledger-class-unknown", project.rel(path),
+                    node.lineno, cls,
+                    f"settle class {cls!r} is not in the ledger CLASSES "
+                    f"vocabulary ({sorted(classes)})",
+                ))
+    return out
+
+
+# -- alert-rule kinds ------------------------------------------------------
+
+def _check_alert_kinds(project: Project) -> list[Finding]:
+    kinds: set[str] = set()
+    for path in project.py_files():
+        for node in ast.walk(project.parse(path)):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                for deco in node.decorator_list:
+                    if isinstance(deco, ast.Call) \
+                            and call_name(deco).endswith("rule_kind") \
+                            and deco.args:
+                        s = str_const(deco.args[0])
+                        if s is not None:
+                            kinds.add(s)
+    if not kinds:
+        return []
+    out: list[Finding] = []
+    for path in project.alert_files:
+        data = json.loads(path.read_text(encoding="utf-8"))
+        rules = data.get("rules", data) if isinstance(data, dict) else data
+        if not isinstance(rules, list):
+            continue
+        for rule in rules:
+            if not isinstance(rule, dict):
+                continue
+            kind = rule.get("kind")
+            if isinstance(kind, str) and kind not in kinds:
+                out.append(Finding(
+                    "alert-kind-unknown", project.rel(path), 1, kind,
+                    f"rule kind {kind!r} is not registered via "
+                    "@rule_kind (build_rule would reject this file at "
+                    "load time)",
+                ))
+    return out

@@ -1,0 +1,105 @@
+"""Env contract lint: every ``TPU_K8S_*`` / ``SERVE_*`` / ``SERVER_*``
+read documented, every documented knob actually read.
+
+The env surface is the operational API of the stack — the serve job's
+module docstring is the canonical cross-ref and the guide tables are
+what an operator greps. Both drift: a knob added under deadline never
+gets a table row (`env-undocumented`), and a renamed knob leaves a dead
+row behind (`env-stale-doc`).
+
+Detection is literal-based: any string constant in package code that
+full-matches one of the prefixes counts as a read site — this catches
+direct ``os.environ.get`` calls, ``env.get`` through an injected
+mapping, the ``ENV_VAR = "..."`` module-constant idiom, and the
+util/envparse helpers uniformly. Documentation sources are the
+markdown guides, README, and module-level docstrings; a doc token with
+a trailing underscore (a family wildcard like a ``*``-suffixed
+mention) is ignored — the family's members are documented
+individually. Staleness additionally accepts reads from the test tree:
+suite-only switches are documented on purpose.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tpu_kubernetes.analysis import (
+    ENV_PREFIX_RE,
+    ENV_TOKEN_RE,
+    Finding,
+    Project,
+    str_const,
+)
+
+
+def run(project: Project) -> list[Finding]:
+    reads = _code_reads(project, project.py_files())
+    test_reads: dict[str, tuple[str, int]] = {}
+    if project.tests_dir is not None:
+        test_files = sorted(
+            p for p in project.tests_dir.rglob("*.py")
+            if "__pycache__" not in p.parts
+        )
+        test_reads = _code_reads(project, test_files, lenient=True)
+    documented = _documented(project)
+
+    out: list[Finding] = []
+    for var in sorted(set(reads) - set(documented)):
+        rel, line = reads[var]
+        out.append(Finding(
+            "env-undocumented", rel, line, var,
+            f"{var} is read here but has no row in the guide tables or "
+            "the serve job docstring cross-ref",
+        ))
+    for var in sorted(set(documented) - set(reads) - set(test_reads)):
+        rel, line = documented[var]
+        out.append(Finding(
+            "env-stale-doc", rel, line, var,
+            f"{var} is documented here but nothing in the package or "
+            "tests reads it",
+        ))
+    return out
+
+
+def _code_reads(project: Project, files: list[Path], *,
+                lenient: bool = False) -> dict[str, tuple[str, int]]:
+    """var → first (path, line) where a string constant full-matches an
+    env prefix. Docstrings can't collide: they never *equal* a bare var
+    name, and substring mentions don't count as reads."""
+    reads: dict[str, tuple[str, int]] = {}
+    for path in files:
+        try:
+            tree = project.parse(path)
+        except SyntaxError:
+            if lenient:
+                continue
+            raise
+        rel = project.rel(path)
+        for node in ast.walk(tree):
+            s = str_const(node) if isinstance(node, ast.Constant) else None
+            if s is not None and ENV_PREFIX_RE.match(s):
+                reads.setdefault(s, (rel, node.lineno))
+    return reads
+
+
+def _documented(project: Project) -> dict[str, tuple[str, int]]:
+    """var → first (path, line) across the markdown guides and package
+    module docstrings (the serve/job.py cross-ref among them)."""
+    docs: dict[str, tuple[str, int]] = {}
+    for path in project.doc_files:
+        rel = project.rel(path)
+        for i, line in enumerate(
+                path.read_text(encoding="utf-8").splitlines(), 1):
+            for tok in ENV_TOKEN_RE.findall(line):
+                if not tok.endswith("_"):
+                    docs.setdefault(tok, (rel, i))
+    for path in project.py_files():
+        tree = project.parse(path)
+        doc = ast.get_docstring(tree, clean=False)
+        if doc:
+            rel = project.rel(path)
+            for tok in ENV_TOKEN_RE.findall(doc):
+                if not tok.endswith("_"):
+                    docs.setdefault(tok, (rel, 1))
+    return docs

@@ -1,0 +1,277 @@
+"""Runtime lock-order watchdog: the dynamic half of the analyzer.
+
+The static concurrency pass proves writes happen under *a* lock; it
+cannot prove two locks are always taken in the same order. This module
+can, for any interleaving a test actually drives: an instrumented
+``threading.Lock`` records, per thread, the set of locks held at every
+acquire and folds them into a process-wide directed graph — an edge
+``A → B`` means "some thread held A while acquiring B". A cycle in that
+graph is a potential deadlock *even if the run never deadlocked*: two
+threads that took ``A→B`` and ``B→A`` on different runs only need the
+right preemption point to stick forever.
+
+Opt-in and zero-cost when off: ``watching()`` monkeypatches
+``threading.Lock``/``RLock`` for the duration (so every lock the
+serve engine / pools / aggregator allocate inside the block is
+instrumented), and the resilience/chaos suites run under it when
+``TPU_K8S_LOCKGRAPH=1`` (see tests/conftest.py and
+``make resilience-check``). ``check()`` raises :class:`LockOrderError`
+on a cycle; ``report()`` includes per-lock max hold times — the
+"what's the longest anyone sat on the engine lock" number the
+scheduler-stall postmortems always want.
+
+The graph's own bookkeeping uses ``_thread.allocate_lock`` — the raw
+primitive — so instrumentation can never recurse into itself, and the
+clock is injectable so hold-time tests don't sleep.
+"""
+
+from __future__ import annotations
+
+import _thread
+import contextlib
+import threading
+import time
+from typing import Callable
+
+ENV_VAR = "TPU_K8S_LOCKGRAPH"
+
+
+class LockOrderError(RuntimeError):
+    """A cycle in the observed lock-acquisition graph — a potential
+    deadlock, reported even though this run happened not to hang."""
+
+
+class LockGraph:
+    """Cross-thread lock-acquisition graph + hold-time accounting."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._mu = _thread.allocate_lock()
+        self._clock = clock
+        # thread ident -> stack of (lock, t_acquired)
+        self._held: dict[int, list[tuple["InstrumentedLock", float]]] = {}
+        # (holder name, acquired name) -> count
+        self._edges: dict[tuple[str, str], int] = {}
+        self._acquires: dict[str, int] = {}
+        self._max_hold: dict[str, float] = {}
+
+    # -- instrumentation callbacks (called by InstrumentedLock) ----------
+
+    def note_acquired(self, lock: "InstrumentedLock") -> None:
+        ident = _thread.get_ident()
+        now = self._clock()
+        with self._mu:
+            stack = self._held.setdefault(ident, [])
+            self._acquires[lock.name] = self._acquires.get(lock.name, 0) + 1
+            for held, _t0 in stack:
+                if held is lock:      # reentrant re-acquire: no edge
+                    break
+            else:
+                for held, _t0 in stack:
+                    edge = (held.name, lock.name)
+                    self._edges[edge] = self._edges.get(edge, 0) + 1
+            stack.append((lock, now))
+
+    def note_released(self, lock: "InstrumentedLock") -> None:
+        ident = _thread.get_ident()
+        now = self._clock()
+        with self._mu:
+            stack = self._held.get(ident, [])
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i][0] is lock:
+                    _l, t0 = stack.pop(i)
+                    dt = now - t0
+                    if dt > self._max_hold.get(lock.name, -1.0):
+                        self._max_hold[lock.name] = dt
+                    break
+
+    # -- analysis --------------------------------------------------------
+
+    def edges(self) -> dict[tuple[str, str], int]:
+        with self._mu:
+            return dict(self._edges)
+
+    def cycles(self) -> list[list[str]]:
+        """Every elementary cycle reachable by DFS, as name lists
+        (first == last). Deterministic: adjacency is sorted."""
+        adj: dict[str, list[str]] = {}
+        for a, b in sorted(self.edges()):
+            adj.setdefault(a, []).append(b)
+        cycles: list[list[str]] = []
+        seen_keys: set[tuple[str, ...]] = set()
+
+        def dfs(node: str, path: list[str], on_path: set[str],
+                done: set[str]) -> None:
+            for nxt in adj.get(node, ()):
+                if nxt in on_path:
+                    cyc = path[path.index(nxt):] + [nxt]
+                    key = tuple(sorted(cyc[:-1]))
+                    if key not in seen_keys:
+                        seen_keys.add(key)
+                        cycles.append(cyc)
+                elif nxt not in done:
+                    on_path.add(nxt)
+                    dfs(nxt, path + [nxt], on_path, done)
+                    on_path.discard(nxt)
+            done.add(node)
+
+        done: set[str] = set()
+        for start in sorted(adj):
+            if start not in done:
+                dfs(start, [start], {start}, done)
+        return cycles
+
+    def check(self) -> None:
+        cycles = self.cycles()
+        if cycles:
+            rendered = "; ".join(" -> ".join(c) for c in cycles)
+            raise LockOrderError(
+                f"lock-order cycle(s) observed (potential deadlock): "
+                f"{rendered}"
+            )
+
+    def report(self) -> dict:
+        with self._mu:
+            locks = {
+                name: {
+                    "acquires": self._acquires.get(name, 0),
+                    "max_hold_s": round(self._max_hold.get(name, 0.0), 6),
+                }
+                for name in sorted(self._acquires)
+            }
+            edges = [
+                {"from": a, "to": b, "count": n}
+                for (a, b), n in sorted(self._edges.items())
+            ]
+        return {"locks": locks, "edges": edges, "cycles": self.cycles()}
+
+
+class InstrumentedLock:
+    """API-complete stand-in for ``threading.Lock``/``RLock`` that
+    reports acquisitions to a :class:`LockGraph`. Reentrant when
+    wrapping an RLock (re-acquire by the holder adds no edge)."""
+
+    def __init__(self, graph: LockGraph, inner=None,
+                 name: str | None = None):
+        self._graph = graph
+        self._inner = inner if inner is not None \
+            else _thread.allocate_lock()
+        self.name = name or f"lock@{id(self):#x}"
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if timeout == -1:
+            ok = self._inner.acquire(blocking)
+        else:
+            ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._graph.note_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        self._graph.note_released(self)
+        self._inner.release()
+
+    # -- threading.Condition protocol ------------------------------------
+    # Condition lifts these off its lock in __init__; without them it
+    # falls back to a try-acquire ownership probe that is wrong for a
+    # reentrant inner lock (an owner's acquire(False) *succeeds*, so
+    # notify()/wait() raise "un-acquired lock" for the actual owner).
+
+    def _is_owned(self) -> bool:
+        owned = getattr(self._inner, "_is_owned", None)
+        if owned is not None:
+            return owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        # Condition.wait: fully drop the lock (all reentrant counts)
+        self._graph.note_released(self)
+        saver = getattr(self._inner, "_release_save", None)
+        if saver is not None:
+            return saver()
+        self._inner.release()
+        return None
+
+    def _acquire_restore(self, state) -> None:
+        restore = getattr(self._inner, "_acquire_restore", None)
+        if restore is not None:
+            restore(state)
+        else:
+            self._inner.acquire()
+        self._graph.note_acquired(self)
+
+    def _at_fork_reinit(self) -> None:
+        # stdlib modules (concurrent.futures.thread) register this with
+        # os.register_at_fork on a module-level threading.Lock()
+        inner_reinit = getattr(self._inner, "_at_fork_reinit", None)
+        if inner_reinit is not None:
+            inner_reinit()
+        else:
+            self._inner = _thread.allocate_lock()
+
+    def locked(self) -> bool:
+        inner = self._inner
+        if hasattr(inner, "locked"):
+            return inner.locked()
+        if inner.acquire(False):      # RLock without locked()
+            inner.release()
+            return False
+        return True
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<InstrumentedLock {self.name}>"
+
+
+def _alloc_site(skip_file: str) -> str:
+    """Name a lock by the source line that allocated it — the stable,
+    human-meaningful identity (``server.py:1507``), shared by every
+    instance a re-created engine allocates there."""
+    import sys
+
+    # skip our own frames AND stdlib threading.py: a lock allocated by
+    # Condition()'s default RLock() must take the *caller's* identity,
+    # or every Condition in the process would merge into one graph node
+    # (shared names merge edges, which can manufacture false cycles)
+    skip = (skip_file, threading.__file__)
+    frame = sys._getframe(2)
+    while frame is not None and frame.f_code.co_filename in skip:
+        frame = frame.f_back
+    if frame is None:
+        return "lock@?"
+    fname = frame.f_code.co_filename.rsplit("/", 1)[-1]
+    return f"{fname}:{frame.f_lineno}"
+
+
+@contextlib.contextmanager
+def watching(graph: LockGraph | None = None):
+    """Instrument every ``threading.Lock()`` / ``threading.RLock()``
+    allocated inside the block; yields the graph. Restores the real
+    factories on exit. Locks allocated before the block stay
+    uninstrumented — run setup inside the block for full coverage
+    (the conftest fixture patches for the whole session)."""
+    g = graph if graph is not None else LockGraph()
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    here = __file__
+
+    def make_lock():
+        return InstrumentedLock(g, orig_lock(), name=_alloc_site(here))
+
+    def make_rlock():
+        return InstrumentedLock(g, orig_rlock(), name=_alloc_site(here))
+
+    threading.Lock = make_lock      # type: ignore[assignment]
+    threading.RLock = make_rlock    # type: ignore[assignment]
+    try:
+        yield g
+    finally:
+        threading.Lock = orig_lock      # type: ignore[assignment]
+        threading.RLock = orig_rlock    # type: ignore[assignment]

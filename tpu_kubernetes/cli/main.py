@@ -252,6 +252,35 @@ def build_parser() -> argparse.ArgumentParser:
              "(default benchmarks/history)",
     )
 
+    analyze = sub.add_parser(
+        "analyze",
+        help="run the repo-native invariant analyzer over the package: "
+             "closed-vocabulary contracts (fault sites, metrics, ledger "
+             "classes, alert kinds), the env contract, and concurrency "
+             "discipline; exits 1 on findings not in the baseline",
+    )
+    analyze.add_argument(
+        "--json", dest="as_json", action="store_true",
+        help="emit the machine-readable findings payload (schema "
+             "version pinned by tests/test_analysis.py)",
+    )
+    analyze.add_argument(
+        "--pass", dest="passes", action="append",
+        choices=["contracts", "env", "concurrency"], metavar="NAME",
+        help="run only this pass (repeatable; default: all of "
+             "contracts, env, concurrency)",
+    )
+    analyze.add_argument(
+        "--root", metavar="DIR", default=None,
+        help="repo root to analyze (default: the tree containing the "
+             "installed tpu_kubernetes package)",
+    )
+    analyze.add_argument(
+        "--baseline", metavar="FILE", default=None,
+        help="baseline file of suppressed findings (default: "
+             "analysis-baseline.json under the root; ships empty)",
+    )
+
     sub.add_parser("version", help="print the version")
     return parser
 
@@ -404,6 +433,34 @@ def main(argv: list[str] | None = None) -> int:
         else:
             print(render_ledger(data), end="")
         return 0
+
+    if args.command == "analyze":
+        # pure AST + text scanning, no backend/config/prompts — and no
+        # import of the analyzed package (docs/guide/static-analysis.md)
+        from pathlib import Path
+
+        from tpu_kubernetes import analysis
+
+        root = Path(args.root) if args.root else \
+            Path(tpu_kubernetes.__file__).resolve().parent.parent
+        passes = args.passes or list(analysis.PASS_NAMES)
+        try:
+            findings = analysis.run_analysis(root, passes)
+            baseline_path = Path(args.baseline) if args.baseline \
+                else root / analysis.BASELINE_NAME
+            baseline = analysis.load_baseline(baseline_path)
+        except (analysis.ProjectError, SyntaxError, OSError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        new, old = analysis.split_baselined(findings, baseline)
+        if args.as_json:
+            print(json.dumps(
+                analysis.report_json(new, old, str(root), passes),
+                indent=2, sort_keys=True,
+            ))
+        else:
+            print(analysis.render_findings(new, old), end="")
+        return 1 if new else 0
 
     if args.command == "get" and args.kind == "metrics":
         # this process's registry (terraform command families registered by

@@ -801,12 +801,14 @@ def sinks_from_env(env: dict | None = None) -> list:
         sinks.append(JSONLSink(path))
     url = env.get("TPU_K8S_ALERT_WEBHOOK", "")
     if url:
+        from tpu_kubernetes.util.envparse import env_float, env_int
+
         sinks.append(WebhookSink(
             url,
-            timeout_s=float(env.get("TPU_K8S_ALERT_WEBHOOK_TIMEOUT_S",
-                                    "2") or 2),
-            retries=int(env.get("TPU_K8S_ALERT_WEBHOOK_RETRIES", "2")
-                        or 2),
+            timeout_s=env_float("TPU_K8S_ALERT_WEBHOOK_TIMEOUT_S", 2.0,
+                                env=env),
+            retries=env_int("TPU_K8S_ALERT_WEBHOOK_RETRIES", 2,
+                            env=env),
         ))
     return sinks
 

@@ -55,19 +55,16 @@ DEFAULT_KEEP = 1
 
 
 def _max_bytes_from_env() -> int:
-    try:
-        mb = float(os.environ.get("TPU_K8S_EVENTS_MAX_MB", "") or DEFAULT_MAX_MB)
-    except ValueError:
-        mb = DEFAULT_MAX_MB
+    from tpu_kubernetes.util.envparse import env_float
+
+    mb = env_float("TPU_K8S_EVENTS_MAX_MB", DEFAULT_MAX_MB)
     return int(mb * 1024 * 1024)
 
 
 def _keep_from_env() -> int:
-    try:
-        keep = int(os.environ.get("TPU_K8S_EVENTS_KEEP", "") or DEFAULT_KEEP)
-    except ValueError:
-        keep = DEFAULT_KEEP
-    return max(1, keep)
+    from tpu_kubernetes.util.envparse import env_int
+
+    return max(1, env_int("TPU_K8S_EVENTS_KEEP", DEFAULT_KEEP))
 
 
 class EventSink:

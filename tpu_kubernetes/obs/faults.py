@@ -39,8 +39,10 @@ ENV_VAR = "TPU_K8S_FAULTS"
 
 # the closed site vocabulary — one name per instrumented failure point.
 # Adding a site = add it here AND thread fire() through the code path;
-# chaos tests iterate this set, so a site that exists only here (never
-# fired) or only in code (never listed) fails the suite.
+# chaos tests iterate this set, and the static contracts pass
+# (`tpu-kubernetes analyze`, docs/guide/static-analysis.md) fails CI on
+# a site that exists only here (fault-site-unfired) or only in code
+# (fault-site-unknown) — no run required to catch the drift.
 SITES = frozenset({
     "serve.prefill",        # prefill/prefill_resume (solo + slot admission)
     "serve.slot_insert",    # _ContinuousEngine._insert (cache graft)

@@ -94,10 +94,9 @@ def redact(obj: Any) -> Any:
 
 
 def _int_env(env: dict, key: str, default: int) -> int:
-    try:
-        return int(env.get(key, "") or default)
-    except (ValueError, TypeError):
-        return default
+    from tpu_kubernetes.util.envparse import env_int
+
+    return env_int(key, default, env=env)
 
 
 class FlightRecorder:

@@ -65,18 +65,15 @@ TAIL_SAMPLES = 32
 
 
 def _int_env(env: dict, key: str, default: int) -> int:
-    try:
-        return int(env.get(key, "") or default)
-    except (ValueError, TypeError):
-        return default
+    from tpu_kubernetes.util.envparse import env_int
+
+    return env_int(key, default, env=env)
 
 
 def _float_env(env: dict, key: str, default: float) -> float:
-    try:
-        raw = env.get(key, "")
-        return float(raw) if raw not in ("", None) else default
-    except (ValueError, TypeError):
-        return default
+    from tpu_kubernetes.util.envparse import env_float
+
+    return env_float(key, default, env=env)
 
 
 class IncidentCorrelator:

@@ -36,6 +36,10 @@ whole pipeline is env-driven like the trainer:
                        models/decode.KVCache). Composes with SERVE_QUANT;
                        rejected in speculative/prompt-lookup modes
                        (exact verification keeps a full-precision cache).
+  SERVE_CACHE_SPAN     pin the KV-cache span (cache size changes XLA's
+                       attention reduction order, so pinning it makes
+                       runs bitwise-comparable across pipelines;
+                       default: fits prompt+max_new exactly)
   SERVE_TEMPERATURE / SERVE_TOP_K / SERVE_TOP_P / SERVE_SEED
   SERVE_EOS_ID         stop rows at this token (emitted tokens after it
                        are dropped from the text)
@@ -88,6 +92,8 @@ import sys
 import time
 from pathlib import Path
 
+from tpu_kubernetes.util.envparse import env_float, env_int
+
 
 def log(*args) -> None:
     print("[serve]", *args, file=sys.stderr, flush=True)
@@ -96,10 +102,11 @@ def log(*args) -> None:
 def truthy_env(env: dict, name: str) -> bool:
     """One falsy-string rule for the whole SERVE_* env contract (shared
     with serve/server.py — diverging copies would make the batch job and
-    the HTTP server read the same env differently)."""
-    return env.get(name, "").strip().lower() not in (
-        "", "0", "false", "no", "off",
-    )
+    the HTTP server read the same env differently). The rule itself
+    lives in util/envparse.py with the other env chokepoints."""
+    from tpu_kubernetes.util.envparse import env_bool
+
+    return env_bool(name, env=env)
 
 
 def _detokenizer(spec: str):
@@ -250,8 +257,8 @@ def run_serving(env: dict | None = None) -> list[str]:
     mesh = create_mesh(shape, devices=devices)
     log(f"mesh={dict(mesh.shape)}")
 
-    max_new = int(env.get("SERVE_MAX_NEW", "64"))
-    batch_rows = int(env.get("SERVE_BATCH", "8"))
+    max_new = env_int("SERVE_MAX_NEW", 64, env=env)
+    batch_rows = env_int("SERVE_BATCH", 8, env=env)
     eos_env = env.get("SERVE_EOS_ID", "")
     eos_id = int(eos_env) if eos_env else None
     pad_id = 0
@@ -299,7 +306,7 @@ def run_serving(env: dict | None = None) -> list[str]:
             )
         # --- speculative decoding: batch-1, greedy, single-device ------
         # cheap config rejections first — before any checkpoint I/O
-        if float(env.get("SERVE_TEMPERATURE", "0")) != 0.0:
+        if env_float("SERVE_TEMPERATURE", 0.0, env=env) != 0.0:
             raise SystemExit(
                 "speculative decoding is greedy: unset SERVE_TEMPERATURE "
                 "or drop the SERVE_DRAFT_*/SERVE_PROMPT_LOOKUP config"
@@ -313,8 +320,8 @@ def run_serving(env: dict | None = None) -> list[str]:
                 "speculative decoding needs a dense TARGET model (MoE "
                 "chunk verification is not token-exact); MoE drafts are fine"
             )
-        draft_k = int(env.get("SERVE_DRAFT_K", "8" if lookup else "4"))
-        ngram = int(env.get("SERVE_NGRAM", "2"))
+        draft_k = env_int("SERVE_DRAFT_K", 8 if lookup else 4, env=env)
+        ngram = env_int("SERVE_NGRAM", 2, env=env)
         if draft_k < 1 or ngram < 1:
             raise SystemExit(
                 f"SERVE_DRAFT_K ({draft_k}) and SERVE_NGRAM ({ngram}) "
@@ -398,9 +405,9 @@ def run_serving(env: dict | None = None) -> list[str]:
         span_env = env.get("SERVE_CACHE_SPAN", "")
         fn, p_sh, b_sh = make_sharded_generate(
             cfg, mesh, params, max_new_tokens=max_new,
-            temperature=float(env.get("SERVE_TEMPERATURE", "0")),
-            top_k=int(env.get("SERVE_TOP_K", "0")),
-            top_p=float(env.get("SERVE_TOP_P", "0")),
+            temperature=env_float("SERVE_TEMPERATURE", 0.0, env=env),
+            top_k=env_int("SERVE_TOP_K", 0, env=env),
+            top_p=env_float("SERVE_TOP_P", 0.0, env=env),
             eos_id=eos_id, pad_id=pad_id,
             cache_span=int(span_env) if span_env else None,
             kv_quant=kv_quant,
@@ -419,7 +426,7 @@ def run_serving(env: dict | None = None) -> list[str]:
             )
 
         params = jax.tree.map(lambda p, s: to_global(p, s), params, p_sh)
-        rng = jax.random.PRNGKey(int(env.get("SERVE_SEED", "0")))
+        rng = jax.random.PRNGKey(env_int("SERVE_SEED", 0, env=env))
 
         t0 = time.perf_counter()
         for start in range(0, len(token_rows), batch_rows):

@@ -538,9 +538,10 @@ class _ContinuousEngine:
         # timed idle wait, so alerts resolve and incidents close without
         # traffic), throttled by TPU_K8S_ALERT_TICK_S
         self._alerts = alerts
-        self._alert_tick_s = float(
-            state.env.get("TPU_K8S_ALERT_TICK_S", "1") or 0
-        )
+        from tpu_kubernetes.util.envparse import env_float
+
+        self._alert_tick_s = env_float("TPU_K8S_ALERT_TICK_S", 1.0,
+                                       env=state.env)
         self._last_alert_tick = 0.0
         self.slots = slots
         self.seg_steps = max(1, seg_steps)
@@ -1477,12 +1478,13 @@ class ServingState:
         import jax  # deferred: the server module must import without jax
 
         from tpu_kubernetes.serve.job import load_serving_stack, truthy_env
+        from tpu_kubernetes.util.envparse import env_float, env_int
 
         self.env = env
         params, cfg, encode, decode_text = load_serving_stack(env)
         self.params, self.cfg = params, cfg
         self.encode, self.decode_text = encode, decode_text
-        self.max_new_cap = int(env.get("SERVE_MAX_NEW", "64"))
+        self.max_new_cap = env_int("SERVE_MAX_NEW", 64, env=env)
         self.kv_quant = truthy_env(env, "SERVE_KV_QUANT")
         # SERVE_PROMPT_LOOKUP: draft-model-free speculation for solo
         # GREEDY requests (models/speculative.py's n-gram idea, run as a
@@ -1491,8 +1493,8 @@ class ServingState:
         # program; proposals cost nothing and never change tokens —
         # acceptance keeps exactly the target's greedy choices.
         self.prompt_lookup = truthy_env(env, "SERVE_PROMPT_LOOKUP")
-        self.draft_k = int(env.get("SERVE_DRAFT_K", "8"))
-        self.ngram = int(env.get("SERVE_NGRAM", "2"))
+        self.draft_k = env_int("SERVE_DRAFT_K", 8, env=env)
+        self.ngram = env_int("SERVE_NGRAM", 2, env=env)
         # cumulative speculation totals: written by batcher-dispatch /
         # handler threads (the _lookup_rounds finally), read by /healthz
         # handler threads — same lock discipline as the metrics registry
@@ -1510,12 +1512,12 @@ class ServingState:
         # SERVE_DEADLINE_MS (default 0 = off): every request gets this
         # deadline unless its body carries a "deadline_ms" override; the
         # clock starts at request receipt (queue time counts).
-        self.deadline_ms = float(env.get("SERVE_DEADLINE_MS", "0") or 0)
+        self.deadline_ms = env_float("SERVE_DEADLINE_MS", 0.0, env=env)
         # SERVE_MAX_QUEUE (default 256, 0 disables): admission control —
         # a full queue sheds with 429 + Retry-After instead of queueing
         # unboundedly behind the generation lock.
         self.admission = AdmissionController(
-            int(env.get("SERVE_MAX_QUEUE", "256") or 0)
+            env_int("SERVE_MAX_QUEUE", 256, env=env)
         )
         self.drain = DrainController()
         self.failed = False          # watchdog gave up: healthz hard-fails
@@ -1598,7 +1600,7 @@ class ServingState:
         # generation lock)
         self._programs: dict = {}
         self._programs_lock = threading.Lock()
-        batch = int(env.get("SERVER_BATCH", "1"))
+        batch = env_int("SERVER_BATCH", 1, env=env)
         self._batcher = None
         self._engine = None
         self.flightrec = None
@@ -1674,7 +1676,7 @@ class ServingState:
 
             self._batcher = _Batcher(
                 self._run_greedy_batch, batch,
-                float(env.get("SERVER_BATCH_WINDOW_MS", "5")),
+                env_float("SERVER_BATCH_WINDOW_MS", 5.0, env=env),
                 fits=fits,
                 on_wait=self.admission.observe_service,
             )
@@ -1684,7 +1686,8 @@ class ServingState:
         # host asks "is any row still live?" and stops the generation
         # early instead of running to the bucketed max. <= 0 disables
         # the mid-run checks (one segment runs the whole budget).
-        self.early_exit_steps = int(env.get("SERVE_EARLY_EXIT_STEPS", "8"))
+        self.early_exit_steps = env_int("SERVE_EARLY_EXIT_STEPS", 8,
+                                        env=env)
         # SERVE_PREFIX_CACHE_MB (> 0 enables): bounded LRU of prompt-
         # prefix KV segments (serve/prefix_cache.py). A request sharing
         # a stored prefix prefills only its suffix — into the SAME cache
@@ -1696,7 +1699,7 @@ class ServingState:
         # warm starts serve sharded too (pinned PAGES, in paged mode,
         # already live sharded in the pool).
         self.prefix_cache = None
-        prefix_mb = float(env.get("SERVE_PREFIX_CACHE_MB", "0") or "0")
+        prefix_mb = env_float("SERVE_PREFIX_CACHE_MB", 0.0, env=env)
         if prefix_mb > 0:
             from tpu_kubernetes.serve.prefix_cache import PrefixCache
 
@@ -1715,8 +1718,8 @@ class ServingState:
         # (free pages), not worst-case context. SERVE_KV_PAGE_SIZE sets
         # the positions per page (power of two dividing the minimum
         # prefix-reuse length, so warm hits stay page-aligned).
-        self.kv_page_size = int(env.get("SERVE_KV_PAGE_SIZE", "16") or 16)
-        self.kv_pool_mb = float(env.get("SERVE_KV_POOL_MB", "0") or 0)
+        self.kv_page_size = env_int("SERVE_KV_PAGE_SIZE", 16, env=env)
+        self.kv_pool_mb = env_float("SERVE_KV_POOL_MB", 0.0, env=env)
         if self.kv_pool_mb > 0 and not self._continuous:
             raise ValueError(
                 "SERVE_KV_POOL_MB needs SERVE_CONTINUOUS_BATCHING=1 "
@@ -1752,17 +1755,17 @@ class ServingState:
                     stats_fn=lambda: (self._engine.stats()
                                       if self._engine is not None else None),
                     ledger=LEDGER,
-                    for_s=float(env.get("TPU_K8S_ALERT_FOR_S", "5") or 0),
-                    resolve_for_s=float(
-                        env.get("TPU_K8S_ALERT_RESOLVE_FOR_S", "10") or 0
+                    for_s=env_float("TPU_K8S_ALERT_FOR_S", 5.0, env=env),
+                    resolve_for_s=env_float(
+                        "TPU_K8S_ALERT_RESOLVE_FOR_S", 10.0, env=env
                     ),
                     queue_max_depth=float(
-                        env.get("SERVE_MAX_QUEUE", "256") or 256
+                        env_int("SERVE_MAX_QUEUE", 256, env=env)
                     ),
                 ),
                 sinks=sinks_from_env(env),
-                group_interval_s=float(
-                    env.get("TPU_K8S_ALERT_GROUP_S", "60") or 0
+                group_interval_s=env_float(
+                    "TPU_K8S_ALERT_GROUP_S", 60.0, env=env
                 ),
                 incidents=self._incidents,
             )
@@ -1785,11 +1788,11 @@ class ServingState:
             # the fleet replaces this instance.
             self._watchdog = Watchdog(
                 self._engine.is_alive, self._engine.restart,
-                max_restarts=int(
-                    env.get("SERVE_MAX_ENGINE_RESTARTS", "3") or 0
+                max_restarts=env_int(
+                    "SERVE_MAX_ENGINE_RESTARTS", 3, env=env
                 ),
-                interval_s=float(
-                    env.get("SERVE_WATCHDOG_INTERVAL_S", "0.5") or 0.5
+                interval_s=env_float(
+                    "SERVE_WATCHDOG_INTERVAL_S", 0.5, env=env
                 ),
                 on_give_up=self._mark_failed,
             ).start()
@@ -3408,8 +3411,10 @@ def make_server(env: dict | None = None) -> ThreadingHTTPServer:
     state.warm()
 
     handler = type("Handler", (_Handler,), {"state": state})
-    host = env.get("SERVER_HOST", "127.0.0.1")
-    port = int(env.get("SERVER_PORT", "8000"))
+    from tpu_kubernetes.util.envparse import env_int, env_str
+
+    host = env_str("SERVER_HOST", "127.0.0.1", env=env)
+    port = env_int("SERVER_PORT", 8000, env=env)
     server = ThreadingHTTPServer((host, port), handler)
     # the drain worker shuts this listener down once quiesced
     state._http_server = server

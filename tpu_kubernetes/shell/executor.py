@@ -339,11 +339,12 @@ def default_executor() -> Executor:
     if shutil.which(os.environ.get("TPU_K8S_TERRAFORM_BIN", "terraform")):
         # TPU_K8S_TF_TIMEOUT_S bounds a wedged command (0 = no deadline);
         # TPU_K8S_TF_RETRIES bounds transient-failure retries
+        from tpu_kubernetes.util.envparse import env_float, env_int
+
         return TerraformExecutor(
             os.environ.get("TPU_K8S_TERRAFORM_BIN", "terraform"),
-            timeout_s=float(os.environ.get("TPU_K8S_TF_TIMEOUT_S", "0")
-                            or 0),
-            retries=int(os.environ.get("TPU_K8S_TF_RETRIES", "2") or 0),
+            timeout_s=env_float("TPU_K8S_TF_TIMEOUT_S", 0.0),
+            retries=env_int("TPU_K8S_TF_RETRIES", 2),
         )
     import sys
 

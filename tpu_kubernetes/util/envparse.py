@@ -1,0 +1,86 @@
+"""One chokepoint for env-var parsing across serve/obs/shell.
+
+The same three idioms were re-derived in a dozen modules —
+``int(env.get("X", "8"))`` (crashes the process on a typo'd value),
+``int(env.get("X", "") or default)`` (silently treats ``"abc"`` as a
+crash too), and per-module ``_int_env`` fallback helpers (flightrec,
+incidents) that at least survived. This module is the one surviving
+spelling, and the one place the env-contract static pass
+(tpu_kubernetes/analysis/envcontract.py) has to understand:
+
+* **Bad values fall back, loudly.** A deployment with
+  ``SERVE_BATCH=eight`` serves with the default batch and one stderr
+  warning instead of dying in the pod restart loop — config mistakes
+  degrade, they don't outage (the stance every obs module already
+  took; now the serve/shell paths agree).
+* **Empty string means unset.** ``SERVE_KV_POOL_MB=""`` is the
+  default, matching the ``or default`` idiom these helpers replace.
+* **One falsy-string rule.** :func:`env_bool` is the
+  ``truthy_env`` rule ("", "0", "false", "no", "off" → False) the
+  batch job and HTTP server already shared; serve/job.py's
+  ``truthy_env`` now delegates here.
+
+Every helper takes the mapping explicitly or defaults to
+``os.environ`` — the serve stack injects its env for testability, the
+shell/obs layers read the process env.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping
+
+FALSY = ("", "0", "false", "no", "off")
+
+
+def _resolve(env: Mapping[str, str] | None) -> Mapping[str, str]:
+    return os.environ if env is None else env
+
+
+def _warn(name: str, raw: str, default) -> None:
+    from tpu_kubernetes.util import log
+
+    log.warn(
+        f"env {name}={raw!r} is not a valid value; using default "
+        f"{default!r}"
+    )
+
+
+def env_str(name: str, default: str = "", *,
+            env: Mapping[str, str] | None = None) -> str:
+    """The raw value, or ``default`` when unset/empty."""
+    return _resolve(env).get(name, "") or default
+
+
+def env_int(name: str, default: int, *,
+            env: Mapping[str, str] | None = None) -> int:
+    raw = _resolve(env).get(name, "")
+    if raw is None or str(raw).strip() == "":
+        return default
+    try:
+        return int(str(raw).strip())
+    except (ValueError, TypeError):
+        _warn(name, raw, default)
+        return default
+
+
+def env_float(name: str, default: float, *,
+              env: Mapping[str, str] | None = None) -> float:
+    raw = _resolve(env).get(name, "")
+    if raw is None or str(raw).strip() == "":
+        return default
+    try:
+        return float(str(raw).strip())
+    except (ValueError, TypeError):
+        _warn(name, raw, default)
+        return default
+
+
+def env_bool(name: str, default: bool = False, *,
+             env: Mapping[str, str] | None = None) -> bool:
+    """The shared falsy-string rule: unset → ``default``; set →
+    anything outside :data:`FALSY` (case/space-insensitive) is True."""
+    raw = _resolve(env).get(name)
+    if raw is None:
+        return default
+    return str(raw).strip().lower() not in FALSY
