@@ -3,7 +3,7 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast analysis-check obs-check monitor-check flightrec-check alerts-check perf-check goodput-check serve-identity-check serve-continuous-check paged-check sharded-check resilience-check bench dryrun native dist dist-offline clean
+.PHONY: test test-fast analysis-check jax-check obs-check monitor-check flightrec-check alerts-check perf-check goodput-check serve-identity-check serve-continuous-check paged-check sharded-check resilience-check bench dryrun native dist dist-offline clean
 
 test:
 	python -m pytest tests/ -q
@@ -13,7 +13,7 @@ test:
 native:
 	python -c "from tpu_kubernetes import native; assert native.available(), 'native build failed'; print('native runtime OK')"
 
-test-fast: analysis-check
+test-fast: analysis-check jax-check
 	python -m pytest tests/ -q -m "not slow"
 
 # Invariant-analyzer gate: the AST contract passes (closed vocabularies,
@@ -22,6 +22,22 @@ test-fast: analysis-check
 # EMPTY, and should stay that way (docs/guide/static-analysis.md).
 analysis-check:
 	python -m tpu_kubernetes analyze
+
+# JAX program-contract gate, both halves: the static jaxcontract pass
+# must be clean (rides on analysis-check), the retrace-sentinel units
+# must pass (including the deliberately-retracing loud-failure test),
+# and the serve-identity suites must run green under TPU_K8S_RETRACE=1 —
+# every jitted program compiles at most once per input signature in
+# steady state, with per-key compile counts and total trace seconds
+# printed at session end (tpu_kubernetes/analysis/retrace.py;
+# tests/conftest.py wraps each test).
+jax-check: analysis-check
+	JAX_PLATFORMS=cpu python -m pytest tests/test_retrace.py -q
+	JAX_PLATFORMS=cpu TPU_K8S_RETRACE=1 python -m pytest \
+	  tests/test_decode.py tests/test_serve_prefix.py \
+	  tests/test_serve_continuous.py tests/test_serve_sharded.py \
+	  tests/test_ledger.py \
+	  -q -m "not slow" -k identity
 
 # Fast observability smoke: registry/events/tracer/exposition units, the
 # history store (tsdb), the fleet aggregator + SLO suite, plus a live

@@ -57,6 +57,50 @@ def _lockgraph_watchdog():
     graph.check()  # raises LockOrderError on any observed cycle
 
 
+# session aggregate for the retrace sentinel: per-program compile
+# counts and total trace seconds across every watched test, rendered by
+# pytest_terminal_summary (the "where did startup time go" number)
+_RETRACE_TOTALS: dict = {"programs": {}, "trace_s": 0.0}
+
+
+def pytest_terminal_summary(terminalreporter):
+    totals = _RETRACE_TOTALS
+    if not totals["programs"]:
+        return
+    terminalreporter.write_sep("-", "retrace sentinel")
+    terminalreporter.write_line(
+        f"total trace time {totals['trace_s']:.3f}s across "
+        f"{len(totals['programs'])} program(s)")
+    worst = sorted(totals["programs"].items(),
+                   key=lambda kv: -kv[1])[:8]
+    for key, n in worst:
+        terminalreporter.write_line(f"{n:3d} compile(s)  {key}")
+
+
+@pytest.fixture(autouse=True)
+def _retrace_watchdog():
+    """Opt-in retrace sentinel (TPU_K8S_RETRACE=1, set by
+    `make jax-check`): wrap every function handed to jax.jit during the
+    test and fail it if any program compiled more than once for the
+    same input signature — steady-state serving must trace each program
+    exactly once (analysis/retrace.py). Function-scoped so each test's
+    freshly built engine is judged on its own compiles."""
+    from tpu_kubernetes.util.envparse import env_bool
+
+    if not env_bool("TPU_K8S_RETRACE"):
+        yield
+        return
+    from tpu_kubernetes.analysis import retrace
+
+    with retrace.watching() as monitor:
+        yield
+    for key, n in monitor.counts().items():
+        _RETRACE_TOTALS["programs"][key] = \
+            _RETRACE_TOTALS["programs"].get(key, 0) + n
+    _RETRACE_TOTALS["trace_s"] += monitor.total_trace_s()
+    monitor.check()  # raises RetraceError on any steady-state retrace
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _flightrec_default_dir(tmp_path_factory):
     """Serve-server fixtures that don't set TPU_K8S_FLIGHTREC_DIR fall back

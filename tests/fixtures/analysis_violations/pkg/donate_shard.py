@@ -1,0 +1,17 @@
+"""Violation: donate-sharding-mismatch (exactly one).
+
+Argument 0 is donated but its in_sharding has no matching
+out_sharding — XLA silently drops the donation and the caller pays the
+full buffer it thought it had donated away.
+"""
+
+import jax
+
+
+def build(step, cache_spec, out_spec):
+    return jax.jit(
+        step,
+        donate_argnums=(0,),
+        in_shardings=(cache_spec, None),
+        out_shardings=(out_spec,),
+    )

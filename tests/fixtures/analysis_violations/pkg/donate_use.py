@@ -1,0 +1,15 @@
+"""Violation: donate-use-after (exactly one).
+
+``cache`` is passed in the donated position of a locally-built donated
+program and then read afterwards — the buffer may already have been
+reused by XLA by the time the read happens.
+"""
+
+import jax
+
+
+def run(step, cache, tok):
+    p = jax.jit(step, donate_argnums=(0,))
+    out = p(cache, tok)
+    stale = cache + out  # read of a donated buffer
+    return stale

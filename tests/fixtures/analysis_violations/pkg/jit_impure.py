@@ -1,0 +1,17 @@
+"""Violation: jit-impure-call (exactly one).
+
+``stamp`` reads the host clock and is handed to jax.jit — the read
+happens once per trace, not once per call.
+"""
+
+import time
+
+import jax
+
+
+def stamp(x):
+    return x + time.time()
+
+
+def build():
+    return jax.jit(stamp)

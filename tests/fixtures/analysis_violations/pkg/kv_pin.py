@@ -1,0 +1,9 @@
+"""Violation: kv-axis-pin (exactly one).
+
+kv_partition_spec places the ``kv`` logical axis at index 0 — KV
+storage keeps kv-heads at axis 2.
+"""
+
+
+def kv_partition_spec(mesh, logical_to_spec):
+    return logical_to_spec(("kv", None, None), mesh=mesh)

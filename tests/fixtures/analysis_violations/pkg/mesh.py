@@ -1,0 +1,8 @@
+"""Mesh-axis vocabulary for the jaxcontract sharding checks.
+
+Declaring MESH_AXES activates the closed-vocabulary axis check for this
+fixture package, the way parallel/mesh.py does for the real tree. This
+module itself commits no violation.
+"""
+
+MESH_AXES = ("data", "tensor")

@@ -1,0 +1,11 @@
+"""Violation: retrace-mutable-default (exactly one).
+
+A mutable default in a program-builder signature is evaluated once and
+aliased across every build.
+"""
+
+import jax
+
+
+def build(step, options={}):
+    return jax.jit(step)

@@ -1,0 +1,14 @@
+"""Violation: retrace-captured-scalar (exactly one).
+
+The jitted lambda captures the enclosing function's per-call parameter
+``steps`` and the program is called in the same body — every
+invocation of ``run`` re-traces with the captured value baked in.
+"""
+
+import jax
+
+
+def run(x, steps):
+    f = jax.jit(lambda y: y * steps)
+    out = f(x)
+    return out

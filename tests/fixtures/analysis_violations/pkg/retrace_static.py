@@ -1,0 +1,14 @@
+"""Violation: retrace-static-argnums (exactly one).
+
+``head`` has two positional parameters; static_argnums=(5,) keys the
+jit cache on nothing.
+"""
+
+import jax
+
+
+def head(x, n):
+    return x[:n]
+
+
+program = jax.jit(head, static_argnums=(5,))

@@ -1,0 +1,20 @@
+"""Violation: shardmap-arity-mismatch (exactly one).
+
+Three in_specs over a two-argument function — the extra spec maps to
+nothing and shard_map would reject the call at trace time.
+"""
+
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def pair_sum(a, b):
+    return a + b
+
+
+def build(mesh):
+    return shard_map(
+        pair_sum, mesh,
+        in_specs=(P("data"), P("data"), P("data")),
+        out_specs=P("data"),
+    )

@@ -1,0 +1,11 @@
+"""Violation: sharding-axis-unknown (exactly one).
+
+``rows`` is not in the fixture package's MESH_AXES vocabulary
+(mesh.py declares data/tensor).
+"""
+
+from jax.sharding import PartitionSpec
+
+
+def specs():
+    return PartitionSpec("rows", None)

@@ -31,6 +31,15 @@ ALL_CODES = {
     "env-stale-doc",
     "lock-unguarded-write",
     "lock-blocking-call",
+    "donate-use-after",
+    "donate-sharding-mismatch",
+    "jit-impure-call",
+    "sharding-axis-unknown",
+    "shardmap-arity-mismatch",
+    "kv-axis-pin",
+    "retrace-captured-scalar",
+    "retrace-static-argnums",
+    "retrace-mutable-default",
 }
 
 
@@ -67,6 +76,16 @@ def test_fixture_findings_carry_stable_symbols_and_locations():
     assert by_code["env-undocumented"].symbol == "SERVE_FIXTURE_UNDOC"
     assert by_code["env-stale-doc"].symbol == "SERVE_FIXTURE_STALE"
     assert by_code["lock-unguarded-write"].symbol == "Engine._count"
+    assert by_code["donate-use-after"].symbol == "run.cache"
+    assert by_code["donate-sharding-mismatch"].symbol == \
+        "donate_argnums[0]"
+    assert by_code["jit-impure-call"].symbol == "stamp:time.time"
+    assert by_code["sharding-axis-unknown"].symbol == "rows"
+    assert by_code["shardmap-arity-mismatch"].symbol == "pair_sum"
+    assert by_code["kv-axis-pin"].symbol == "kv_partition_spec"
+    assert by_code["retrace-captured-scalar"].symbol == "run.f"
+    assert by_code["retrace-static-argnums"].symbol == "head"
+    assert by_code["retrace-mutable-default"].symbol == "build.options"
     for f in findings:
         assert f.path and not f.path.startswith("/"), f
         assert f.line >= 1, f
@@ -102,12 +121,15 @@ def test_cli_json_schema_contract(capsys):
     assert rc == 1
     assert set(payload) == {
         "version", "root", "passes", "ok", "counts", "findings",
-        "baselined",
+        "baselined", "timings",
     }
-    assert payload["version"] == analysis.JSON_SCHEMA_VERSION
+    assert payload["version"] == analysis.JSON_SCHEMA_VERSION == 2
     assert payload["ok"] is False
     assert payload["passes"] == sorted(analysis.PASS_NAMES)
     assert payload["baselined"] == []
+    # per-pass wall time rides along so analyzer slowdowns are visible
+    assert set(payload["timings"]) == set(analysis.PASS_NAMES)
+    assert all(t >= 0.0 for t in payload["timings"].values())
     for f in payload["findings"]:
         assert set(f) == {"code", "pass", "path", "line", "message",
                           "symbol"}
@@ -162,7 +184,8 @@ def test_shipped_baseline_file_is_empty():
     assert data["suppress"] == []
 
 
-@pytest.mark.parametrize("name", ["contracts", "env", "concurrency"])
+@pytest.mark.parametrize("name", ["contracts", "env", "concurrency",
+                                  "jaxcontract"])
 def test_each_pass_runs_standalone_on_the_real_tree(name):
     project = analysis.Project.discover(REPO_ROOT)
     assert analysis.run_pass(project, name) == []
@@ -172,3 +195,50 @@ def test_unknown_pass_is_a_project_error():
     project = analysis.Project.discover(REPO_ROOT)
     with pytest.raises(analysis.ProjectError):
         analysis.run_pass(project, "nope")
+
+
+def test_update_baseline_rewrites_atomically_with_diff(tmp_path, capsys):
+    baseline = tmp_path / "baseline.json"
+    # seed a stale entry so the diff shows a removal too
+    baseline.write_text(json.dumps({"suppress": [
+        {"code": "env-stale-doc", "path": "gone.py", "symbol": "GONE"},
+    ]}))
+    rc = main(["analyze", "--root", str(FIXTURE_ROOT),
+               "--baseline", str(baseline), "--update-baseline"])
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "-1 removed" in err and "- env-stale-doc gone.py [GONE]" in err
+    assert "+ lock-unguarded-write pkg/locked.py [Engine._count]" in err
+    entries = json.loads(baseline.read_text())["suppress"]
+    assert {e["code"] for e in entries} == ALL_CODES
+    assert entries == sorted(
+        entries, key=lambda e: (e["code"], e["path"], e["symbol"]))
+    assert not baseline.with_name(baseline.name + ".tmp").exists()
+    # the rewritten baseline suppresses everything: the gate goes green
+    assert main(["analyze", "--root", str(FIXTURE_ROOT),
+                 "--baseline", str(baseline)]) == 0
+
+
+def test_condition_counts_as_a_lock_context(tmp_path):
+    # `with self._cv:` acquires the Condition's lock — writes under it
+    # are guarded, writes elsewhere are the blind spot the pass must
+    # catch (lives outside the fixture tree to keep one-per-code exact)
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "waiters.py").write_text(
+        "import threading\n\n\n"
+        "class Pool:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "        self._waiters = 0\n\n"
+        "    def enter(self):\n"
+        "        with self._cv:\n"
+        "            self._waiters += 1\n\n"
+        "    def leak(self):\n"
+        "        self._waiters -= 1\n"
+    )
+    project = analysis.Project.discover(tmp_path)
+    findings = analysis.run_pass(project, "concurrency")
+    assert [(f.code, f.symbol) for f in findings] == \
+        [("lock-unguarded-write", "Pool._waiters")]

@@ -1,0 +1,165 @@
+"""Retrace sentinel (analysis/retrace.py): compile counting, signature
+bucketing, trace-time accounting, and the loud steady-state failure.
+
+These run in tier-1 without TPU_K8S_RETRACE — they drive ``watching()``
+directly. The env switch only controls the conftest watchdog that wraps
+the serve-identity suites under ``make jax-check``.
+"""
+
+import functools
+import itertools
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_kubernetes.analysis import retrace
+from tpu_kubernetes.analysis.retrace import (
+    RetraceError,
+    RetraceMonitor,
+    watching,
+)
+
+
+def test_steady_state_counts_one_compile():
+    with watching() as m:
+        f = jax.jit(lambda x: x * 2.0)
+        for _ in range(5):
+            f(jnp.ones((4,)))
+    counts = m.counts()
+    assert list(counts.values()) == [1]
+    m.check()  # one compile per key: steady state, no raise
+
+
+def test_shape_buckets_are_distinct_keys_not_retraces():
+    """The serve engine's width buckets each trace once — distinct
+    input signatures must land on distinct keys, not read as a
+    retrace of one program."""
+    with watching() as m:
+        f = jax.jit(lambda x: x + 1.0)
+        for width in (4, 8, 16):
+            f(jnp.ones((width,)))
+            f(jnp.ones((width,)))  # second call: cached, no trace
+    counts = m.counts()
+    assert len(counts) == 3
+    assert all(n == 1 for n in counts.values())
+    m.check()
+
+
+class _UnstableCfg:
+    """A static argument with identity hashing but a stable repr — the
+    canonical runtime retrace bug: every fresh instance misses the jit
+    cache even though the program is identical."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __repr__(self):
+        return f"_UnstableCfg({self.n})"
+
+
+def test_deliberate_retrace_fails_loudly():
+    """One compiled program tracing repeatedly for the same signature —
+    a fresh hash-unstable static per call — must raise from check()
+    with the program named and its compile count."""
+    with watching() as m:
+        f = jax.jit(lambda x, cfg: x * cfg.n, static_argnums=(1,))
+        for _ in range(3):
+            f(jnp.ones((2,)), _UnstableCfg(2))  # id-hash: cache miss
+    with pytest.raises(RetraceError, match="compiled 3x"):
+        m.check()
+    assert m.report()["retraced"]
+
+
+def test_check_respects_max_compiles():
+    with watching() as m:
+        f = jax.jit(lambda x, cfg: x * cfg.n, static_argnums=(1,))
+        for _ in range(2):
+            f(jnp.ones((2,)), _UnstableCfg(3))
+    with pytest.raises(RetraceError):
+        m.check()
+    m.check(max_compiles=2)  # the observed count is allowed
+
+
+def test_sibling_programs_at_one_site_are_not_retraces():
+    """Two jit instances from the same source line, each tracing once —
+    the solo-vs-batched identity pattern builds two engines whose
+    program builders share call sites. The report shows the aggregate
+    compile count; check() stays green."""
+    with watching() as m:
+        for _ in range(2):
+            f = jax.jit(lambda y: y * 3.0)
+            f(jnp.ones((2,)))
+    assert list(m.counts().values()) == [2]  # visible in the report
+    assert m.report()["retraced"] == []
+    m.check()  # each instance compiled once: no steady-state retrace
+
+
+def test_static_argnames_survive_the_wrapper():
+    """The wrapper sets __wrapped__ so inspect.signature (which jit's
+    static_argnames lookup uses) resolves the real function."""
+
+    def head(x, n):
+        return x[:n]
+
+    with watching() as m:
+        f = jax.jit(head, static_argnames=("n",))
+        assert list(f(jnp.arange(6), n=3)) == [0, 1, 2]
+        assert list(f(jnp.arange(6), n=3)) == [0, 1, 2]
+    # same (shape, static value): one compile
+    assert list(m.counts().values()) == [1]
+
+
+def test_partial_is_wrapped_without_error():
+    """functools.partial has no __name__ — the hand-rolled wraps must
+    tolerate it and fall back to the underlying function's name."""
+
+    def scale(x, k):
+        return x * k
+
+    with watching() as m:
+        f = jax.jit(functools.partial(scale, k=2.0))
+        assert float(f(jnp.ones(()))) == 2.0
+    (key,) = m.counts()
+    assert "scale" in key
+
+
+def test_decorator_with_options_form():
+    with watching() as m:
+
+        @jax.jit
+        def double(x):
+            return x * 2
+
+        double(jnp.ones((3,)))
+    assert list(m.counts().values()) == [1]
+    m.check()
+
+
+def test_trace_time_accounting_uses_injected_clock():
+    ticks = itertools.count()
+    m = RetraceMonitor(clock=lambda: float(next(ticks)))
+    with watching(m):
+        jax.jit(lambda x: x + 1)(jnp.ones((2,)))
+    assert m.total_trace_s() == 1.0  # exactly one t1 - t0 interval
+    assert m.report()["total_trace_s"] == 1.0
+
+
+def test_watching_restores_jax_jit():
+    orig = jax.jit
+    with watching():
+        assert jax.jit is not orig
+    assert jax.jit is orig
+
+
+def test_report_shape():
+    with watching() as m:
+        jax.jit(lambda x: x)(jnp.ones((1,)))
+    rep = m.report()
+    assert set(rep) == {"programs", "total_trace_s", "retraced"}
+    assert rep["retraced"] == []
+    assert all(n == 1 for n in rep["programs"].values())
+
+
+def test_env_var_matches_docs():
+    assert retrace.ENV_VAR == "TPU_K8S_RETRACE"

@@ -12,17 +12,25 @@ regressed silently at least once (the spec_totals lock fix, SLO
 flapping, counter-reset clamps); this package makes them *mechanical*:
 
 * **AST passes** (:mod:`contracts`, :mod:`envcontract`,
-  :mod:`concurrency`) lint the package source without importing it —
-  no jax, no side effects, fast enough for a pre-commit hook.
+  :mod:`concurrency`, :mod:`jaxcontract`) lint the package source
+  without importing it — no jax, no side effects, fast enough for a
+  pre-commit hook. :mod:`jaxcontract` covers the JAX program
+  contracts: donation safety, jit purity (an interprocedural
+  reachability fixpoint over :mod:`callresolve`), PartitionSpec /
+  shard_map sharding specs, and static retrace hazards.
 * **A runtime lock-order watchdog** (:mod:`lockgraph`) instruments
   ``threading.Lock`` during the chaos/resilience suites, builds the
   cross-thread lock-acquisition graph, and fails the run on a cycle.
+* **A runtime retrace sentinel** (:mod:`retrace`) instruments
+  ``jax.jit`` during the serve-identity suites (``TPU_K8S_RETRACE=1``,
+  ``make jax-check``) and fails any test where one compiled program
+  traces twice for the same input signature.
 
-Surfaces: ``tpu-kubernetes analyze [--json] [--pass NAME]`` and
-``make analysis-check`` (exits non-zero on findings not in the
-committed baseline, ``analysis-baseline.json`` — intentionally empty on
-the shipped tree). docs/guide/static-analysis.md documents every
-finding code and the baseline workflow.
+Surfaces: ``tpu-kubernetes analyze [--json] [--pass NAME]
+[--update-baseline]`` and ``make analysis-check`` (exits non-zero on
+findings not in the committed baseline, ``analysis-baseline.json`` —
+intentionally empty on the shipped tree). docs/guide/static-analysis.md
+documents every finding code and the baseline workflow.
 """
 
 from __future__ import annotations
@@ -72,11 +80,39 @@ FINDING_CODES = {
     "lock-blocking-call":
         "blocking call (sleep / urlopen / subprocess / terraform exec) "
         "made while a lock is held",
+    "donate-use-after":
+        "variable read after being passed in a donated position of a "
+        "jit/kv_jit/kv_shard_map program (the buffer may be reused)",
+    "donate-sharding-mismatch":
+        "donated jit whose out_shardings don't match in_shardings on "
+        "the donated argument — XLA silently drops the donation",
+    "jit-impure-call":
+        "host effect (time/env/metrics/print/locks/random) reachable "
+        "from a function handed to jit/shard_map — runs per trace, "
+        "not per call",
+    "sharding-axis-unknown":
+        "PartitionSpec axis literal not in the package's MESH_AXES "
+        "mesh-axis vocabulary",
+    "shardmap-arity-mismatch":
+        "shard_map in_specs arity doesn't fit the wrapped function's "
+        "positional signature",
+    "kv-axis-pin":
+        "kv_partition_spec moved the 'kv' logical axis off index 2 "
+        "(the KV-storage axis-2 kv-heads layout contract)",
+    "retrace-captured-scalar":
+        "jit over a closure capturing per-call parameters, called in "
+        "the same body — recompiles on every invocation",
+    "retrace-static-argnums":
+        "static_argnums/static_argnames don't fit the wrapped "
+        "function's signature — the compile cache keys on nothing",
+    "retrace-mutable-default":
+        "mutable default argument in a program-builder signature "
+        "(aliased across every build)",
 }
 
-PASS_NAMES = ("contracts", "env", "concurrency")
+PASS_NAMES = ("contracts", "env", "concurrency", "jaxcontract")
 
-JSON_SCHEMA_VERSION = 1
+JSON_SCHEMA_VERSION = 2
 
 
 @dataclass(frozen=True)
@@ -161,6 +197,19 @@ class Project:
             if "__pycache__" not in p.parts
         )
 
+    def tests_py_files(self) -> list[Path]:
+        """Test-tree sources, excluding ``fixtures/`` — the intentional
+        violation packages under tests/fixtures/ must not count as real
+        read sites (a repo-root run would otherwise let a fixture env
+        read mask a genuinely stale doc row)."""
+        if self.tests_dir is None:
+            return []
+        return sorted(
+            p for p in self.tests_dir.rglob("*.py")
+            if "__pycache__" not in p.parts
+            and "fixtures" not in p.parts
+        )
+
     def parse(self, path: Path) -> ast.Module:
         if self._sources is None:
             self._sources = {}
@@ -187,12 +236,18 @@ class Project:
 # -- pass registry ---------------------------------------------------------
 
 def run_pass(project: Project, name: str) -> list[Finding]:
-    from tpu_kubernetes.analysis import concurrency, contracts, envcontract
+    from tpu_kubernetes.analysis import (
+        concurrency,
+        contracts,
+        envcontract,
+        jaxcontract,
+    )
 
     table: dict[str, Callable[[Project], list[Finding]]] = {
         "contracts": contracts.run,
         "env": envcontract.run,
         "concurrency": concurrency.run,
+        "jaxcontract": jaxcontract.run,
     }
     if name not in table:
         raise ProjectError(
@@ -209,11 +264,27 @@ def run_analysis(root: str | Path, passes: Iterable[str] | None = None,
                  ) -> list[Finding]:
     """Run the requested passes (default: all) over ``root`` and return
     findings sorted by (path, line, code)."""
+    findings, _timings = run_analysis_timed(root, passes)
+    return findings
+
+
+def run_analysis_timed(root: str | Path,
+                       passes: Iterable[str] | None = None,
+                       ) -> tuple[list[Finding], dict[str, float]]:
+    """Like :func:`run_analysis`, also returning per-pass wall time in
+    seconds (what ``analyze --json`` reports, so analyzer slowdowns
+    show up in review)."""
+    import time
+
     project = Project.discover(root)
     out: list[Finding] = []
+    timings: dict[str, float] = {}
     for name in (passes or PASS_NAMES):
+        t0 = time.perf_counter()
         out.extend(run_pass(project, name))
-    return sorted(out, key=lambda f: (f.path, f.line, f.code, f.symbol))
+        timings[name] = round(time.perf_counter() - t0, 6)
+    return (sorted(out, key=lambda f: (f.path, f.line, f.code, f.symbol)),
+            timings)
 
 
 # -- baseline --------------------------------------------------------------
@@ -249,15 +320,25 @@ def load_baseline(path: str | Path) -> set[tuple[str, str, str]]:
 
 
 def write_baseline(path: str | Path, findings: list[Finding]) -> None:
+    """Atomically rewrite the baseline from current findings: entries
+    sorted and deduplicated by (code, path, symbol), written to a temp
+    file and renamed into place so a crashed run can't leave a
+    truncated gate file behind."""
+    import os
+
+    keys = sorted({f.key() for f in findings})
     entries = [
-        {"code": f.code, "path": f.path, "symbol": f.symbol}
-        for f in findings
+        {"code": code, "path": p, "symbol": symbol}
+        for code, p, symbol in keys
     ]
-    Path(path).write_text(
+    path = Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(
         json.dumps({"version": 1, "suppress": entries},
                    indent=2, sort_keys=True) + "\n",
         encoding="utf-8",
     )
+    os.replace(tmp, path)
 
 
 def split_baselined(findings: list[Finding],
@@ -271,9 +352,11 @@ def split_baselined(findings: list[Finding],
 
 
 def report_json(findings: list[Finding], baselined: list[Finding],
-                root: str, passes: Iterable[str]) -> dict:
+                root: str, passes: Iterable[str],
+                timings: dict[str, float] | None = None) -> dict:
     """The ``analyze --json`` payload — a stable schema monitor-style
-    tooling consumes (tests/test_analysis.py pins it)."""
+    tooling consumes (tests/test_analysis.py pins it). ``timings`` is
+    per-pass wall seconds from :func:`run_analysis_timed`."""
     counts: dict[str, int] = {}
     for f in findings:
         counts[f.code] = counts.get(f.code, 0) + 1
@@ -283,6 +366,7 @@ def report_json(findings: list[Finding], baselined: list[Finding],
         "passes": sorted(passes),
         "ok": not findings,
         "counts": counts,
+        "timings": dict(sorted((timings or {}).items())),
         "findings": [f.to_dict() for f in findings],
         "baselined": [f.to_dict() for f in baselined],
     }

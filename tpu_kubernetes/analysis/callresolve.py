@@ -1,0 +1,172 @@
+"""Interprocedural call resolution for the AST passes.
+
+The purity fixpoint in :mod:`jaxcontract` needs to follow a call from
+``serve/server.py`` into ``models/decode.py`` and onward into
+``ops/flash_attention.py`` without importing any of them; the
+concurrency pass needs the narrower ``self.helper()`` resolution for
+its lock-context fixpoint. Both shapes live here so they stay
+consistent: one index of every function/method definition in the
+package plus every import alias, and one resolver that turns a dotted
+call name (as :func:`tpu_kubernetes.analysis.call_name` renders it)
+back into the definition it lands on.
+
+Resolution is deliberately best-effort and *under*-approximate: a call
+that cannot be resolved (a bound method on an arbitrary object, a
+function received as a parameter, anything outside the package) is
+skipped, never guessed. The passes that build on this are linting for
+hazards, where a false positive costs more than a miss.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tpu_kubernetes.analysis import Project
+
+
+@dataclass(frozen=True)
+class FuncRef:
+    """One function/method definition: where it is and its AST."""
+
+    module: str                 # repo-relative path, forward slashes
+    qualname: str               # "fn" or "Class.fn"
+    node: ast.AST               # FunctionDef / AsyncFunctionDef / Lambda
+    path: Path
+
+
+@dataclass
+class ModuleInfo:
+    path: Path
+    rel: str
+    # local name -> dotted module target ("jnp" -> "jax.numpy");
+    # includes function-level imports (they resolve the same way)
+    import_alias: dict[str, str] = field(default_factory=dict)
+    # local name -> (source module dotted, original name) for
+    # ``from X import Y [as Z]``
+    from_imports: dict[str, tuple[str, str]] = field(default_factory=dict)
+    # top-level defs and methods by qualname
+    defs: dict[str, FuncRef] = field(default_factory=dict)
+
+
+def self_method_call(name: str) -> str | None:
+    """``self.helper`` → ``helper`` for intra-class call-site
+    resolution (the concurrency pass's lock-context fixpoint); any
+    other shape resolves to None."""
+    parts = name.split(".")
+    if len(parts) == 2 and parts[0] == "self":
+        return parts[1]
+    return None
+
+
+class CallIndex:
+    """Package-wide function index + import-aware call resolver."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.pkg_name = project.pkg.name
+        self.modules: dict[str, ModuleInfo] = {}
+        for path in project.py_files():
+            rel = project.rel(path)
+            info = ModuleInfo(path=path, rel=rel)
+            tree = project.parse(path)
+            self._index_imports(tree, info)
+            self._index_defs(tree, info)
+            self.modules[self._dotted(path)] = info
+
+    # -- construction -----------------------------------------------------
+
+    def _dotted(self, path: Path) -> str:
+        """File path → dotted module name rooted at the package."""
+        rel = path.resolve().relative_to(self.project.pkg.parent)
+        parts = list(rel.with_suffix("").parts)
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    def _index_imports(self, tree: ast.Module, info: ModuleInfo) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    info.import_alias[alias.asname or
+                                      alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for alias in node.names:
+                    info.from_imports[alias.asname or alias.name] = \
+                        (node.module, alias.name)
+
+    def _index_defs(self, tree: ast.Module, info: ModuleInfo) -> None:
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.defs[node.name] = FuncRef(
+                    info.rel, node.name, node, info.path)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        q = f"{node.name}.{sub.name}"
+                        info.defs[q] = FuncRef(info.rel, q, sub, info.path)
+                        # methods also resolve bare for self.X() chains
+                        info.defs.setdefault(
+                            sub.name,
+                            FuncRef(info.rel, q, sub, info.path))
+
+    # -- resolution -------------------------------------------------------
+
+    def module_of(self, path: Path) -> ModuleInfo | None:
+        return self.modules.get(self._dotted(path))
+
+    def resolve(self, name: str, mod: ModuleInfo,
+                cls: str | None = None) -> FuncRef | None:
+        """Resolve a dotted call name seen inside ``mod`` (optionally
+        inside class ``cls``) to the FuncRef it lands on, or None."""
+        parts = name.split(".")
+        if len(parts) == 1:
+            # local def, or ``from X import Y``
+            if cls is not None and f"{cls}.{parts[0]}" in mod.defs:
+                return mod.defs[f"{cls}.{parts[0]}"]
+            if parts[0] in mod.defs:
+                ref = mod.defs[parts[0]]
+                # bare method names only resolve inside their class
+                if "." in ref.qualname and cls is None:
+                    return None
+                return ref
+            src = mod.from_imports.get(parts[0])
+            if src is not None:
+                return self._lookup(src[0], src[1])
+            return None
+        if parts[0] == "self" and len(parts) == 2 and cls is not None:
+            return mod.defs.get(f"{cls}.{parts[1]}")
+        # ``alias.attr...`` through ``import X [as alias]``
+        target = mod.import_alias.get(parts[0])
+        if target is not None:
+            return self._lookup(".".join([target] + parts[1:-1]),
+                                parts[-1])
+        # ``from X import Y`` where Y is a module
+        src = mod.from_imports.get(parts[0])
+        if src is not None and len(parts) == 2:
+            return self._lookup(f"{src[0]}.{src[1]}", parts[1])
+        return None
+
+    def _lookup(self, module: str, func: str,
+                _depth: int = 0) -> FuncRef | None:
+        info = self.modules.get(module)
+        if info is None:
+            return None
+        ref = info.defs.get(func)
+        if ref is not None:
+            return ref
+        # re-export chain: ``from tpu_kubernetes.ops import
+        # flash_attention`` lands on ops/__init__.py, which itself
+        # does ``from .flash_attention import flash_attention``
+        if _depth < 8:
+            src = info.from_imports.get(func)
+            if src is not None:
+                return self._lookup(src[0], src[1], _depth + 1)
+            # or the name is a submodule: X.Y where Y is a module
+            sub = self.modules.get(f"{module}.{func}")
+            if sub is not None:
+                return None
+        return None

@@ -2,9 +2,11 @@
 blocking calls under a lock.
 
 Scope is self-selecting: any class that owns a ``threading.Lock`` /
-``RLock`` attribute (``self._lock = threading.Lock()``) is treated as
-shared-state, and the attributes it ever writes *under* that lock
-become the guarded set. Two findings:
+``RLock`` / ``Condition`` attribute (``self._lock = threading.Lock()``,
+``self._cv = threading.Condition()``) is treated as shared-state, and
+the attributes it ever writes *under* that lock become the guarded
+set. A ``with self._cv`` block acquires the Condition's underlying
+lock, so it counts as a lock context like any other. Two findings:
 
 * `lock-unguarded-write` — a guarded attribute written outside a
   ``with self.<lock>`` region. Exemptions keep the pass honest about
@@ -36,8 +38,12 @@ import ast
 from dataclasses import dataclass, field
 
 from tpu_kubernetes.analysis import Finding, Project, call_name
+from tpu_kubernetes.analysis.callresolve import self_method_call
 
-LOCK_FACTORIES = ("Lock", "RLock", "InstrumentedLock")
+# Condition wraps a lock and `with self._cv` acquires it — attributes
+# written under a Condition context are lock-guarded exactly like
+# attributes written under the bare lock it wraps
+LOCK_FACTORIES = ("Lock", "RLock", "Condition", "InstrumentedLock")
 PRAGMA = "lint: unlocked-ok"
 
 
@@ -204,10 +210,9 @@ def _check_class(cls: ast.ClassDef, rel: str,
     sites: dict[str, list[_Call]] = {m.name: [] for m in methods}
     for scan in scans.values():
         for c in scan.calls:
-            parts = c.name.split(".")
-            if len(parts) == 2 and parts[0] == "self" \
-                    and parts[1] in sites:
-                sites[parts[1]].append(c)
+            method = self_method_call(c.name)
+            if method is not None and method in sites:
+                sites[method].append(c)
     lock_ctx: set[str] = set()
     changed = True
     while changed:

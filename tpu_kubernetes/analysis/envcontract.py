@@ -37,11 +37,10 @@ def run(project: Project) -> list[Finding]:
     reads = _code_reads(project, project.py_files())
     test_reads: dict[str, tuple[str, int]] = {}
     if project.tests_dir is not None:
-        test_files = sorted(
-            p for p in project.tests_dir.rglob("*.py")
-            if "__pycache__" not in p.parts
-        )
-        test_reads = _code_reads(project, test_files, lenient=True)
+        # tests_py_files excludes tests/fixtures/ — the intentional
+        # violation packages must not register as real read sites
+        test_reads = _code_reads(project, project.tests_py_files(),
+                                 lenient=True)
     documented = _documented(project)
 
     out: list[Finding] = []

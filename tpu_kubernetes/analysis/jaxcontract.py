@@ -1,0 +1,853 @@
+"""JAX program-contract lint: donation safety, jit purity, sharding
+specs, and static retrace hazards.
+
+The serving/training stack compiles everything through a small set of
+program builders — ``jax.jit`` directly, the mesh-aware ``kv_jit`` /
+``kv_shard_map`` wrappers (parallel/serving.py), and the engine's
+``_kv_program`` / ``_model_program`` / ``_cached_program`` cache
+(serve/server.py). Four contracts gate their performance and
+correctness, and all four fail *silently* at runtime — as a recompile
+per request, a doubled KV buffer, or bitwise drift — which is exactly
+the failure class static lint is for:
+
+* **donation safety** (`donate-use-after`, `donate-sharding-mismatch`)
+  — a buffer passed in a donated position is dead the moment the call
+  is issued; reading it afterwards is undefined (XLA may have reused
+  the pages). And a donated jit whose out_shardings don't match the
+  in_shardings on the donated argument silently *drops* the donation:
+  GSPMD has to materialize a relaid-out copy, so the engine pays the
+  full cache allocation it thought it had donated away.
+* **jit purity** (`jit-impure-call`) — a reachability fixpoint from
+  every function handed to a jit/shard_map family builder flags host
+  effects in traced code: ``time.*``, ``os.environ`` / ``env_*``,
+  ``REGISTRY`` metrics, ``FAULTS.fire``, ``print``, lock acquisition,
+  the stdlib ``random`` module. A host effect inside a traced body
+  runs once per *trace*, not once per call — wrong if the caller meant
+  per-call, and a silent no-op after the first trace if they meant
+  always. Deliberate trace-time accounting (the kernel wrappers'
+  per-trace dispatch counters) is annotated in place with
+  ``# lint: jit-impure-ok``.
+* **sharding contract** (`sharding-axis-unknown`,
+  `shardmap-arity-mismatch`, `kv-axis-pin`) — every ``PartitionSpec``
+  axis literal must be in the mesh-axis vocabulary harvested from the
+  package's module-level ``MESH_AXES``; shard_map ``in_specs`` arity
+  must fit the wrapped function's signature; and ``kv_partition_spec``
+  must keep the kv-heads logical axis at index 2 — the one KV-storage
+  sharding rule every cache array in models/decode.py is shaped
+  around.
+* **retrace hazards** (`retrace-captured-scalar`,
+  `retrace-static-argnums`, `retrace-mutable-default`) — a jit built
+  over a closure that captures the enclosing function's *parameters*
+  and is then called in the same body compiles fresh on every
+  invocation (the captured scalar is baked into the trace);
+  ``static_argnums`` / ``static_argnames`` that don't fit the wrapped
+  signature mean the cache keys on the wrong thing; a mutable default
+  in a program-builder signature aliases state across builds.
+
+The runtime half is :mod:`tpu_kubernetes.analysis.retrace`
+(``TPU_K8S_RETRACE=1``, ``make jax-check``): this pass proves the
+*shape* of the program set is sane; the sentinel proves no program
+actually compiles twice in steady state.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from pathlib import Path
+
+from tpu_kubernetes.analysis import (
+    Finding,
+    Project,
+    call_name,
+    literal_str_seq,
+)
+from tpu_kubernetes.analysis.callresolve import (
+    CallIndex,
+    FuncRef,
+    ModuleInfo,
+)
+
+PRAGMA = "lint: jit-impure-ok"
+
+# builders whose first argument is traced ("arg 0"), and the engine's
+# program-cache methods whose *second* positional argument is (the
+# first is the cache key)
+JIT_BUILDERS = {
+    "jax.jit": 0, "jit": 0, "kv_jit": 0, "kv_shard_map": 0,
+    "shard_map": 0, "shard_map_compat": 0, "jax.shard_map": 0,
+    "_kv_program": 1, "_model_program": 1,
+}
+# the subset that actually *compiles* per builder object — what the
+# retrace-captured-scalar rule cares about (plain shard_map only traces
+# inside an enclosing jit)
+COMPILING_BUILDERS = ("jax.jit", "jit", "kv_jit", "kv_shard_map")
+
+# host-effect call prefixes: first dotted segment → hazard family
+IMPURE_ROOTS = {
+    "time": "time.*",
+    "random": "the stdlib random module",
+    "os": None,          # os.environ only — see _impure_reason
+    "REGISTRY": "a REGISTRY metric",
+    "FAULTS": None,      # FAULTS.fire only
+    "print": "print",
+}
+ENV_HELPERS = ("env_bool", "env_int", "env_float", "env_str")
+
+
+def run(project: Project) -> list[Finding]:
+    index = CallIndex(project)
+    axes = _mesh_axis_vocab(project)
+    out: list[Finding] = []
+    out.extend(_check_donation(project, index))
+    out.extend(_check_purity(project, index))
+    out.extend(_check_sharding(project, index, axes))
+    out.extend(_check_retrace(project, index))
+    return out
+
+
+# -- shared helpers --------------------------------------------------------
+
+
+def _builder_call(node: ast.Call) -> tuple[str, int] | None:
+    """(builder name, traced-arg index) when ``node`` invokes a jit
+    family builder, else None. Matches on the final attribute so
+    ``self._jax.jit`` and ``st._kv_program`` resolve too."""
+    name = call_name(node)
+    last = name.split(".")[-1]
+    for builder, arg in JIT_BUILDERS.items():
+        if name == builder or last == builder.split(".")[-1]:
+            return builder, arg
+    return None
+
+
+def _traced_target(node: ast.Call) -> ast.AST | None:
+    """The function expression a builder call traces: its positional
+    arg at the builder's traced index, unwrapping functools.partial."""
+    hit = _builder_call(node)
+    if hit is None:
+        return None
+    _, idx = hit
+    if len(node.args) <= idx:
+        return None
+    target = node.args[idx]
+    if isinstance(target, ast.Call) \
+            and call_name(target).split(".")[-1] == "partial" \
+            and target.args:
+        return target.args[0]
+    return target
+
+
+def _int_tuple(node: ast.AST) -> tuple[int, ...] | None:
+    """A literal int, or tuple/list of literal ints, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, int)
+                    and not isinstance(el.value, bool)):
+                return None
+            out.append(el.value)
+        return tuple(out)
+    return None
+
+
+def _kwarg(node: ast.Call, *names: str) -> ast.AST | None:
+    for kw in node.keywords:
+        if kw.arg in names:
+            return kw.value
+    return None
+
+
+def _expr_path(node: ast.AST) -> str | None:
+    """A stable textual path for a Name or dotted attribute chain
+    (``cache``, ``self._cache``), else None."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _functions(tree: ast.Module):
+    """Yield (funcdef, enclosing class name or None) for every def in a
+    module, at any nesting depth."""
+    def walk(node: ast.AST, cls: str | None):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                yield child, cls
+                yield from walk(child, cls)
+            else:
+                yield from walk(child, cls)
+    yield from walk(tree, None)
+
+
+def _own_body_walk(fn: ast.AST):
+    """Walk a function's body WITHOUT descending into nested defs or
+    lambdas (their execution is deferred — a different scope). Document
+    order: the donation pass registers a donated program before it sees
+    the call that kills the buffer."""
+    stack = list(ast.iter_child_nodes(fn))[::-1]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(list(ast.iter_child_nodes(node))[::-1])
+
+
+def _param_names(fn: ast.AST) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def _positional_arity(fn: ast.AST, drop_self: bool = True,
+                      bound_kw: set[str] | None = None,
+                      ) -> tuple[int, int]:
+    """(required, maximum) positional arity of a def, minus any
+    keyword-bound params (a functools.partial's keywords)."""
+    a = fn.args
+    pos = [p.arg for p in a.posonlyargs + a.args]
+    if drop_self and pos and pos[0] in ("self", "cls"):
+        pos = pos[1:]
+    n_default = len(a.defaults)
+    required = [p for p in pos[:len(pos) - n_default]]
+    optional = [p for p in pos[len(pos) - n_default:]]
+    if bound_kw:
+        required = [p for p in required if p not in bound_kw]
+        optional = [p for p in optional if p not in bound_kw]
+    if a.vararg is not None:
+        return len(required), 10 ** 6
+    return len(required), len(required) + len(optional)
+
+
+# -- pass 1: donation safety ----------------------------------------------
+
+
+def _donated_indices(node: ast.Call) -> tuple[int, ...] | None:
+    val = _kwarg(node, "donate_argnums", "donate")
+    if val is None:
+        return None
+    idxs = _int_tuple(val)
+    if not idxs:
+        return None
+    return idxs
+
+
+def _check_donation(project: Project, index: CallIndex) -> list[Finding]:
+    out: list[Finding] = []
+    for path in project.py_files():
+        tree = project.parse(path)
+        rel = project.rel(path)
+        for fn, _cls in _functions(tree):
+            out.extend(_donation_in_function(fn, rel))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                out.extend(_donation_sharding(node, rel))
+    return out
+
+
+def _donation_in_function(fn: ast.AST, rel: str) -> list[Finding]:
+    """Flag reads of a variable after it was passed in a donated
+    position of a locally-built donated program. Lexical, line-ordered
+    approximation: a later store to the same path clears the taint
+    (the engine's ``self._cache = ins(self._cache, ...)`` idiom)."""
+    donated_programs: dict[str, tuple[int, ...]] = {}
+    # path -> (donate line, program name); cleared on reassignment
+    dead: dict[str, tuple[int, str]] = {}
+    stores: dict[str, list[int]] = {}
+    reads: dict[str, list[int]] = {}
+
+    for node in _own_body_walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            idxs = _donated_indices(node.value)
+            if idxs is not None and _builder_call(node.value) is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        donated_programs[t.id] = idxs
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                p = _expr_path(t)
+                if p is not None:
+                    stores.setdefault(p, []).append(node.lineno)
+        if isinstance(node, ast.Call):
+            prog = _expr_path(node.func)
+            if prog in donated_programs:
+                for i in donated_programs[prog]:
+                    if i < len(node.args):
+                        p = _expr_path(node.args[i])
+                        if p is not None:
+                            dead.setdefault(p, (node.lineno, prog))
+        if isinstance(node, (ast.Name, ast.Attribute)) \
+                and isinstance(getattr(node, "ctx", None), ast.Load):
+            p = _expr_path(node)
+            if p is not None:
+                reads.setdefault(p, []).append(node.lineno)
+
+    out: list[Finding] = []
+    for p, (line, prog) in dead.items():
+        revived = [ln for ln in stores.get(p, []) if ln >= line]
+        kill = min(revived) if revived else None
+        bad = [ln for ln in reads.get(p, [])
+               if ln > line and (kill is None or ln < kill)]
+        if bad:
+            out.append(Finding(
+                "donate-use-after", rel, min(bad),
+                f"{fn.name}.{p}",
+                f"{p} was donated to {prog}() on line {line} and read "
+                f"here — the buffer may already be reused by XLA; "
+                f"rebind the program's result instead",
+            ))
+    return out
+
+
+def _donation_sharding(node: ast.Call, rel: str) -> list[Finding]:
+    """Donated jit with literal in/out shardings: the donated arg's
+    in_sharding must appear among the out_shardings, or XLA drops the
+    donation and the engine silently double-buffers."""
+    hit = _builder_call(node)
+    if hit is None or hit[0] not in ("jax.jit", "jit"):
+        return []
+    idxs = _donated_indices(node)
+    in_sh = _kwarg(node, "in_shardings")
+    out_sh = _kwarg(node, "out_shardings")
+    if idxs is None or not isinstance(in_sh, (ast.Tuple, ast.List)) \
+            or out_sh is None:
+        return []
+    out_elts = out_sh.elts if isinstance(out_sh, (ast.Tuple, ast.List)) \
+        else [out_sh]
+    out_dumps = {ast.dump(e) for e in out_elts}
+    findings = []
+    for i in idxs:
+        if i >= len(in_sh.elts):
+            continue
+        if ast.dump(in_sh.elts[i]) not in out_dumps:
+            findings.append(Finding(
+                "donate-sharding-mismatch", rel, node.lineno,
+                f"donate_argnums[{i}]",
+                f"argument {i} is donated but its in_sharding has no "
+                f"matching out_sharding — XLA silently drops the "
+                f"donation and re-materializes the buffer",
+            ))
+    return findings
+
+
+# -- pass 2: jit purity ----------------------------------------------------
+
+
+def _impure_reason(name: str, metric_objects: set[str]) -> str | None:
+    parts = name.split(".")
+    root = parts[0]
+    if name == "print":
+        return "print writes to the host once per trace"
+    if root == "time":
+        return "time.* reads the host clock at trace time"
+    if root == "random":
+        return "stdlib random draws host entropy at trace time " \
+               "(use jax.random)"
+    if root == "os" and len(parts) >= 2 and parts[1] == "environ":
+        return "os.environ is read at trace time, not per call"
+    if root in ENV_HELPERS:
+        return f"{root}() reads the environment at trace time"
+    if root == "REGISTRY" or root in metric_objects:
+        return "metric updates in traced code run once per trace, " \
+               "not per call"
+    if root == "FAULTS" and len(parts) >= 2 and parts[1] == "fire":
+        return "FAULTS.fire in traced code fires per trace, not per call"
+    if len(parts) >= 2 and parts[-1] == "acquire":
+        return "lock acquisition in traced code guards the trace, " \
+               "not the execution"
+    return None
+
+
+def _metric_objects(project: Project, index: CallIndex) -> set[str]:
+    """Module-level names bound to REGISTRY factories (counters,
+    gauges, histograms) — calls on them are REGISTRY effects."""
+    names: set[str] = set()
+    for path in project.py_files():
+        tree = project.parse(path)
+        for node in tree.body:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and call_name(node.value).startswith("REGISTRY."):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names
+
+
+def _purity_entries(project: Project, index: CallIndex):
+    """Yield (FuncRef, entry description) for every function object
+    handed to a jit/shard_map family builder anywhere in the package,
+    plus inline lambdas as (lambda node, module) pairs."""
+    for path in project.py_files():
+        tree = project.parse(path)
+        mod = index.module_of(path)
+        if mod is None:
+            continue
+        for fn, cls in _functions(tree):
+            local_defs = {
+                n.name: n for n in ast.walk(fn)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n is not fn
+            }
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = _traced_target(node)
+                if target is None:
+                    continue
+                if isinstance(target, ast.Lambda):
+                    yield ("lambda", target, mod, cls,
+                           f"{fn.name}:<lambda>")
+                elif isinstance(target, ast.Name):
+                    if target.id in local_defs:
+                        yield ("def", local_defs[target.id], mod, cls,
+                               f"{fn.name}.{target.id}")
+                    else:
+                        ref = index.resolve(target.id, mod, cls)
+                        if ref is not None:
+                            yield ("ref", ref, mod, cls, ref.qualname)
+                elif isinstance(target, ast.Attribute):
+                    name = _expr_path(target)
+                    if name is not None:
+                        ref = index.resolve(name, mod, cls)
+                        if ref is not None:
+                            yield ("ref", ref, mod, cls, ref.qualname)
+
+
+def _check_purity(project: Project, index: CallIndex) -> list[Finding]:
+    metric_objects = _metric_objects(project, index)
+    lines_cache: dict[Path, list[str]] = {}
+
+    def src_lines(path: Path) -> list[str]:
+        if path not in lines_cache:
+            lines_cache[path] = path.read_text(
+                encoding="utf-8").splitlines()
+        return lines_cache[path]
+
+    out: list[Finding] = []
+    seen_findings: set[tuple[str, int]] = set()
+    visited: set[int] = set()       # id() of scanned function nodes
+
+    def scan(fn_node: ast.AST, mod: ModuleInfo, cls: str | None,
+             entry: str, depth: int) -> None:
+        if id(fn_node) in visited or depth > 12:
+            return
+        visited.add(id(fn_node))
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            reason = _impure_reason(name, metric_objects)
+            if reason is not None:
+                src = src_lines(mod.path)
+                text = src[node.lineno - 1] \
+                    if node.lineno <= len(src) else ""
+                if PRAGMA in text:
+                    continue
+                key = (mod.rel, node.lineno)
+                if key not in seen_findings:
+                    seen_findings.add(key)
+                    out.append(Finding(
+                        "jit-impure-call", mod.rel, node.lineno,
+                        f"{entry}:{name}",
+                        f"{name}() is reachable from jitted entry "
+                        f"{entry}: {reason}",
+                    ))
+                continue
+            # skip jax/numpy internals; follow package calls only
+            root = name.split(".")[0]
+            if root in ("jax", "jnp", "lax", "np", "numpy", "functools"):
+                continue
+            ref = index.resolve(name, mod, cls)
+            if ref is not None:
+                nxt_mod = index.module_of(ref.path)
+                nxt_cls = ref.qualname.split(".")[0] \
+                    if "." in ref.qualname else None
+                if nxt_mod is not None:
+                    scan(ref.node, nxt_mod, nxt_cls, entry, depth + 1)
+
+    for kind, node, mod, cls, entry in _purity_entries(project, index):
+        if kind == "ref":
+            ref: FuncRef = node
+            ref_mod = index.module_of(ref.path)
+            ref_cls = ref.qualname.split(".")[0] \
+                if "." in ref.qualname else None
+            if ref_mod is not None:
+                scan(ref.node, ref_mod, ref_cls, entry, 0)
+        else:
+            scan(node, mod, cls, entry, 0)
+    return sorted(out, key=lambda f: (f.path, f.line))
+
+
+# -- pass 3: sharding contract --------------------------------------------
+
+
+def _mesh_axis_vocab(project: Project) -> set[str] | None:
+    """The closed mesh-axis vocabulary: the union of every module-level
+    ``MESH_AXES = (...)`` literal in the package (parallel/mesh.py on
+    the real tree). None when the package declares no vocabulary — the
+    axis check then has nothing to enforce."""
+    axes: set[str] = set()
+    found = False
+    for path in project.py_files():
+        tree = project.parse(path)
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "MESH_AXES":
+                        vals = literal_str_seq(node.value)
+                        if vals is not None:
+                            axes.update(vals)
+                            found = True
+    return axes if found else None
+
+
+def _pspec_aliases(mod: ModuleInfo) -> set[str]:
+    """Local names that refer to jax.sharding.PartitionSpec."""
+    names = {"PartitionSpec"}
+    for local, (src, orig) in mod.from_imports.items():
+        if orig == "PartitionSpec":
+            names.add(local)
+    return names
+
+
+def _spec_axis_literals(node: ast.Call):
+    """Yield (axis string, line) for literal axis names in a
+    PartitionSpec call: direct string args and elements of literal
+    tuple/list args (incl. starred literals). Computed expressions are
+    skipped — only literals are checkable."""
+    def from_elts(elts):
+        for el in elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                yield el.value, el.lineno
+
+    args = []
+    for a in node.args:
+        args.append(a.value if isinstance(a, ast.Starred) else a)
+    for a in args:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            yield a.value, a.lineno
+        elif isinstance(a, (ast.Tuple, ast.List)):
+            yield from from_elts(a.elts)
+
+
+def _check_sharding(project: Project, index: CallIndex,
+                    axes: set[str] | None) -> list[Finding]:
+    out: list[Finding] = []
+    for path in project.py_files():
+        tree = project.parse(path)
+        rel = project.rel(path)
+        mod = index.module_of(path)
+        if mod is None:
+            continue
+        aliases = _pspec_aliases(mod)
+        for fn, cls in _functions(tree):
+            if fn.name == "kv_partition_spec":
+                out.extend(_check_kv_pin(fn, rel))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if axes is not None and name.split(".")[-1] in aliases:
+                for axis, line in _spec_axis_literals(node):
+                    if axis not in axes:
+                        out.append(Finding(
+                            "sharding-axis-unknown", rel, line, axis,
+                            f"PartitionSpec axis {axis!r} is not in the "
+                            f"mesh-axis vocabulary "
+                            f"({', '.join(sorted(axes))})",
+                        ))
+            out.extend(_check_shardmap_arity(node, rel, index, mod))
+    return out
+
+
+def _check_kv_pin(fn: ast.AST, rel: str) -> list[Finding]:
+    """kv_partition_spec must keep the ``kv`` logical axis at index 2 —
+    the axis-2 kv-heads pin every cache array in models/decode.py is
+    documented against."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and call_name(node).split(".")[-1] == "logical_to_spec" \
+                and node.args \
+                and isinstance(node.args[0], (ast.Tuple, ast.List)):
+            elts = node.args[0].elts
+            kv_at = [
+                i for i, el in enumerate(elts)
+                if isinstance(el, ast.Constant) and el.value == "kv"
+            ]
+            if kv_at != [2]:
+                where = kv_at[0] if kv_at else None
+                return [Finding(
+                    "kv-axis-pin", rel, node.lineno, "kv_partition_spec",
+                    f"kv_partition_spec places the 'kv' logical axis at "
+                    f"index {where} — KV storage keeps kv-heads at axis "
+                    f"2 (models/decode.py cache layout contract)",
+                )]
+            return []
+    return []
+
+
+def _check_shardmap_arity(node: ast.Call, rel: str, index: CallIndex,
+                          mod: ModuleInfo) -> list[Finding]:
+    name = call_name(node).split(".")[-1]
+    if name not in ("shard_map", "shard_map_compat"):
+        return []
+    in_specs = _kwarg(node, "in_specs")
+    if in_specs is None and len(node.args) >= 3:
+        in_specs = node.args[2]
+    if not isinstance(in_specs, (ast.Tuple, ast.List)) or not node.args:
+        return []
+    n_specs = len(in_specs.elts)
+    target = node.args[0]
+    bound_kw: set[str] = set()
+    if isinstance(target, ast.Call) \
+            and call_name(target).split(".")[-1] == "partial" \
+            and target.args:
+        bound_kw = {kw.arg for kw in target.keywords if kw.arg}
+        target = target.args[0]
+    fn_node = None
+    if isinstance(target, ast.Name):
+        ref = index.resolve(target.id, mod)
+        if ref is not None and isinstance(
+                ref.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_node = ref.node
+    elif isinstance(target, ast.Lambda):
+        fn_node = target
+    if fn_node is None:
+        return []
+    lo, hi = _positional_arity(fn_node, bound_kw=bound_kw)
+    if lo <= n_specs <= hi:
+        return []
+    want = str(lo) if lo == hi else f"{lo}..{hi}"
+    fname = getattr(fn_node, "name", "<lambda>")
+    return [Finding(
+        "shardmap-arity-mismatch", rel, node.lineno, fname,
+        f"in_specs has {n_specs} entries but {fname} takes {want} "
+        f"positional argument(s)",
+    )]
+
+
+# -- pass 4: retrace hazards ----------------------------------------------
+
+
+def _free_names(fn: ast.AST) -> set[str]:
+    """Names loaded in a function body that it neither binds as a
+    parameter nor assigns locally — its closure reads."""
+    bound = set(_param_names(fn)) | {"self", "cls"}
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    loads: set[str] = set()
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Store):
+                    bound.add(node.id)
+                elif isinstance(node.ctx, ast.Load):
+                    loads.add(node.id)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                bound.add(node.name)
+    return loads - bound
+
+
+@dataclass
+class _JitBuild:
+    node: ast.Call
+    target: ast.AST
+    assigned: str | None
+
+
+def _check_retrace(project: Project, index: CallIndex) -> list[Finding]:
+    out: list[Finding] = []
+    for path in project.py_files():
+        tree = project.parse(path)
+        rel = project.rel(path)
+        mod = index.module_of(path)
+        for fn, cls in _functions(tree):
+            out.extend(_retrace_in_function(fn, rel, index, mod))
+            out.extend(_mutable_defaults(fn, rel))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                out.extend(_static_argnums(node, rel, index, mod))
+    return out
+
+
+def _retrace_in_function(fn: ast.AST, rel: str, index: CallIndex,
+                         mod: ModuleInfo | None) -> list[Finding]:
+    """A jit built in this body over a closure capturing this
+    function's parameters, then *called* in this body (not returned,
+    not deferred into a nested def, not cached) — compiles fresh per
+    invocation with the captured scalar baked in."""
+    params = set(_param_names(fn))
+    if not params:
+        return []
+    local_defs = {}
+    builds: list[_JitBuild] = []
+    returned: set[str] = set()
+    called: dict[str, int] = {}
+    nested_refs: set[str] = set()
+    stored_away: set[str] = set()
+
+    for node in _own_body_walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local_defs[node.name] = node
+            for name in _free_names(node):
+                nested_refs.add(name)
+        elif isinstance(node, ast.Lambda):
+            for name in _free_names(node):
+                nested_refs.add(name)
+        elif isinstance(node, ast.Return) and node.value is not None:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name):
+                    returned.add(sub.id)
+        elif isinstance(node, ast.Assign):
+            if isinstance(node.value, ast.Call) \
+                    and _builder_call(node.value) is not None \
+                    and _builder_call(node.value)[0] in \
+                    COMPILING_BUILDERS:
+                target = _traced_target(node.value)
+                if target is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            builds.append(_JitBuild(
+                                node.value, target, t.id))
+                        else:
+                            # stored into a cache dict / attribute:
+                            # a keyed program, not a per-call rebuild
+                            pass
+        elif isinstance(node, ast.Call):
+            prog = _expr_path(node.func)
+            if prog is not None and "." not in prog:
+                called.setdefault(prog, node.lineno)
+
+    out: list[Finding] = []
+    for b in builds:
+        if b.assigned is None or b.assigned not in called:
+            continue
+        if b.assigned in returned or b.assigned in nested_refs \
+                or b.assigned in stored_away:
+            continue
+        if isinstance(b.target, ast.Lambda):
+            captured = sorted(_free_names(b.target) & params)
+            tname = "<lambda>"
+        elif isinstance(b.target, ast.Name) \
+                and b.target.id in local_defs:
+            captured = sorted(
+                _free_names(local_defs[b.target.id]) & params)
+            tname = b.target.id
+        else:
+            continue    # module function or parameter: no capture
+        if not captured:
+            continue
+        out.append(Finding(
+            "retrace-captured-scalar", rel, b.node.lineno,
+            f"{fn.name}.{b.assigned}",
+            f"{b.assigned} jits {tname} which captures per-call "
+            f"parameter(s) {', '.join(captured)} and is called in the "
+            f"same body — every invocation re-traces; key a cached "
+            f"program on the captured value instead",
+        ))
+    return out
+
+
+def _static_argnums(node: ast.Call, rel: str, index: CallIndex,
+                    mod: ModuleInfo | None) -> list[Finding]:
+    hit = _builder_call(node)
+    if hit is None or hit[0] not in ("jax.jit", "jit"):
+        return []
+    target = _traced_target(node)
+    fn_node = None
+    if isinstance(target, ast.Name) and mod is not None:
+        ref = index.resolve(target.id, mod)
+        if ref is not None and isinstance(
+                ref.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_node = ref.node
+    elif isinstance(target, ast.Lambda):
+        fn_node = target
+    if fn_node is None:
+        return []
+    out: list[Finding] = []
+    nums = _kwarg(node, "static_argnums")
+    if nums is not None:
+        idxs = _int_tuple(nums)
+        if idxs is not None:
+            _lo, hi = _positional_arity(fn_node)
+            bad = [i for i in idxs if i < 0 or (hi < 10 ** 6
+                                               and i >= hi)]
+            if bad:
+                fname = getattr(fn_node, "name", "<lambda>")
+                out.append(Finding(
+                    "retrace-static-argnums", rel, node.lineno, fname,
+                    f"static_argnums {bad} out of range for {fname} "
+                    f"({hi} positional argument(s)) — the cache keys "
+                    f"on nothing and every call may retrace",
+                ))
+    names = _kwarg(node, "static_argnames")
+    if names is not None:
+        vals = literal_str_seq(names)
+        if vals is None and isinstance(names, ast.Constant) \
+                and isinstance(names.value, str):
+            vals = [names.value]
+        if vals is not None:
+            have = set(_param_names(fn_node))
+            bad_names = [v for v in vals if v not in have]
+            if bad_names:
+                fname = getattr(fn_node, "name", "<lambda>")
+                out.append(Finding(
+                    "retrace-static-argnums", rel, node.lineno, fname,
+                    f"static_argnames {bad_names} not parameters of "
+                    f"{fname}",
+                ))
+    return out
+
+
+def _mutable_defaults(fn: ast.AST, rel: str) -> list[Finding]:
+    """Mutable default in a program-builder signature: the default is
+    evaluated once and aliased across every build."""
+    has_builder = any(
+        isinstance(n, ast.Call) and _builder_call(n) is not None
+        for n in ast.walk(fn)
+    )
+    if not has_builder:
+        return []
+    out: list[Finding] = []
+    a = fn.args
+    for p, default in zip(
+            (a.posonlyargs + a.args)[-len(a.defaults):]
+            if a.defaults else [], a.defaults):
+        if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+            out.append(Finding(
+                "retrace-mutable-default", rel, default.lineno,
+                f"{fn.name}.{p.arg}",
+                f"mutable default for {p.arg!r} in program builder "
+                f"{fn.name}() is shared across every build",
+            ))
+    for p, default in zip(a.kwonlyargs, a.kw_defaults):
+        if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+            out.append(Finding(
+                "retrace-mutable-default", rel, default.lineno,
+                f"{fn.name}.{p.arg}",
+                f"mutable default for {p.arg!r} in program builder "
+                f"{fn.name}() is shared across every build",
+            ))
+    return out

@@ -1,0 +1,235 @@
+"""Runtime retrace sentinel: the dynamic half of the jaxcontract pass.
+
+The static retrace checks in :mod:`jaxcontract` catch the *shapes* of
+retrace bugs (a jitted closure over a per-call scalar, a bad
+``static_argnums``); they cannot see a hash-unstable static argument
+or a shape that drifts between steps. This module can, for any call
+pattern a test actually drives: ``watching()`` monkeypatches
+``jax.jit`` so that every function handed to it is wrapped with a
+trace counter — the wrapper's Python body only executes while JAX is
+tracing, so each execution *is* one compile of that program. Compiles
+are keyed by (allocation site, function name, jit instance, input
+signature), where the signature is the pytree structure plus per-leaf
+shape/dtype (and ``repr`` for static leaves): the engine's deliberate
+width buckets land on distinct signatures, and sibling engines built
+in one test (the solo-vs-batched identity pattern) land on distinct
+jit instances — neither reads as a retrace. What does is one compiled
+program tracing twice for the same signature, the hash-unstable-static
+/ dropped-cache bug the static pass cannot see.
+
+Opt-in and zero-cost when off: the serve-identity suites run under it
+when ``TPU_K8S_RETRACE=1`` (see tests/conftest.py and
+``make jax-check``). ``check()`` raises :class:`RetraceError` if any
+key compiled more than once — steady-state code must trace each
+program exactly once — and ``report()`` includes per-key compile
+counts plus total seconds spent tracing, the "where did startup time
+go" number.
+
+The monitor's own bookkeeping uses ``_thread.allocate_lock`` and an
+injectable clock, mirroring :mod:`.lockgraph`.
+"""
+
+from __future__ import annotations
+
+import _thread
+import contextlib
+import functools
+import time
+from typing import Callable
+
+ENV_VAR = "TPU_K8S_RETRACE"
+
+
+class RetraceError(RuntimeError):
+    """A jitted program traced more than once for the same input
+    signature — recompilation in what should be steady state."""
+
+
+def _abstract(leaf) -> str:
+    """One pytree leaf → a stable signature token: shape/dtype for
+    arrays and tracers, ``repr`` for hashable statics."""
+    aval = getattr(leaf, "aval", None)
+    if aval is not None:
+        shape = tuple(getattr(aval, "shape", ()))
+        return f"{getattr(aval, 'dtype', '?')}{shape}"
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}{tuple(shape)}"
+    return repr(leaf)
+
+
+def _signature(args: tuple, kwargs: dict) -> tuple:
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return (str(treedef), tuple(_abstract(x) for x in leaves))
+
+
+class RetraceMonitor:
+    """Per-program compile counts + total trace-time accounting.
+
+    Counts are kept per jit *instance* (one ``jax.jit(...)`` call) and
+    aggregated per (site, name, signature) for reporting. The check is
+    per instance: one compiled program tracing twice for the same input
+    signature is the runtime retrace bug — a hash-unstable static, a
+    dropped cache. Two engine instances each compiling ``prefill`` once
+    at the same source line are *not* a retrace (the identity suites
+    build a solo and a batched engine side by side on purpose); the
+    per-call-rebuild shape (many instances from one site) is what the
+    static ``retrace-captured-scalar`` rule exists for, and still shows
+    up in the report's aggregated counts."""
+
+    def __init__(self, clock: Callable[[], float] = time.perf_counter):
+        self._mu = _thread.allocate_lock()
+        self._clock = clock
+        self._seq = 0
+        # (site, fn name, jit instance, signature) -> compile count
+        self._counts: dict[tuple, int] = {}
+        self._trace_s = 0.0
+
+    # -- instrumentation callback (called by the jit wrapper) ------------
+
+    def note_trace(self, site: str, name: str, inst: int, sig: tuple,
+                   seconds: float) -> None:
+        key = (site, name, inst, sig)
+        with self._mu:
+            self._counts[key] = self._counts.get(key, 0) + 1
+            self._trace_s += seconds
+
+    def wrap(self, fun, site: str):
+        """Wrap ``fun`` so each execution of its body (i.e. each trace)
+        is recorded under (site, name, jit instance, input signature)."""
+        name = getattr(fun, "__name__", None)
+        if name is None and isinstance(fun, functools.partial):
+            name = getattr(fun.func, "__name__", None)
+        name = name or type(fun).__name__
+        monitor = self
+        with self._mu:
+            self._seq += 1
+            inst = self._seq
+
+        def traced(*args, **kwargs):
+            t0 = monitor._clock()
+            try:
+                return fun(*args, **kwargs)
+            finally:
+                monitor.note_trace(site, name, inst,
+                                   _signature(args, kwargs),
+                                   monitor._clock() - t0)
+
+        # functools.wraps by hand: partials lack __name__/__qualname__
+        # and must not abort the copy; __wrapped__ keeps
+        # inspect.signature (and jit's static_argnames lookup) honest
+        for attr in ("__module__", "__name__", "__qualname__", "__doc__"):
+            try:
+                setattr(traced, attr, getattr(fun, attr))
+            except AttributeError:
+                pass
+        traced.__dict__.update(getattr(fun, "__dict__", {}))
+        traced.__wrapped__ = fun
+        return traced
+
+    # -- analysis --------------------------------------------------------
+
+    @staticmethod
+    def _render(site: str, name: str, sig: tuple) -> str:
+        leaves = ", ".join(sig[1][:4])
+        if len(sig[1]) > 4:
+            leaves += f", +{len(sig[1]) - 4}"
+        return f"{site} {name}({leaves})"
+
+    def counts(self) -> dict[str, int]:
+        """Rendered program key → total compile count across every jit
+        instance at that (site, name, signature), deterministic order.
+        A count above the number of instances means a real retrace; a
+        count equal to it means that many programs were built there."""
+        with self._mu:
+            items = sorted(self._counts.items(),
+                           key=lambda kv: (kv[0][0], kv[0][1],
+                                           str(kv[0][3]), kv[0][2]))
+        out: dict[str, int] = {}
+        for (site, name, _inst, sig), n in items:
+            key = self._render(site, name, sig)
+            out[key] = out.get(key, 0) + n
+        return out
+
+    def retraced(self, max_compiles: int = 1) -> dict[str, int]:
+        """Rendered key → worst per-instance compile count, for keys
+        where a single jit instance traced more than ``max_compiles``
+        times for one signature — the true runtime retraces."""
+        with self._mu:
+            items = sorted(self._counts.items(),
+                           key=lambda kv: (kv[0][0], kv[0][1],
+                                           str(kv[0][3]), kv[0][2]))
+        out: dict[str, int] = {}
+        for (site, name, _inst, sig), n in items:
+            if n > max_compiles:
+                key = self._render(site, name, sig)
+                out[key] = max(out.get(key, 0), n)
+        return out
+
+    def total_trace_s(self) -> float:
+        with self._mu:
+            return self._trace_s
+
+    def check(self, max_compiles: int = 1) -> None:
+        bad = self.retraced(max_compiles)
+        if bad:
+            rendered = "; ".join(f"{k} compiled {n}x"
+                                 for k, n in bad.items())
+            raise RetraceError(
+                f"program(s) retraced in steady state "
+                f"(limit {max_compiles} compile(s) per signature): "
+                f"{rendered}"
+            )
+
+    def report(self) -> dict:
+        return {
+            "programs": self.counts(),
+            "total_trace_s": round(self.total_trace_s(), 6),
+            "retraced": sorted(self.retraced()),
+        }
+
+
+def _alloc_site(skip_file: str) -> str:
+    """Name a program by the source line that called ``jax.jit`` — the
+    stable identity shared by every re-created engine that builds its
+    programs there (mirrors lockgraph's lock naming)."""
+    import sys
+
+    frame = sys._getframe(2)
+    while frame is not None and frame.f_code.co_filename == skip_file:
+        frame = frame.f_back
+    if frame is None:
+        return "jit@?"
+    fname = frame.f_code.co_filename.rsplit("/", 1)[-1]
+    return f"{fname}:{frame.f_lineno}"
+
+
+@contextlib.contextmanager
+def watching(monitor: RetraceMonitor | None = None):
+    """Instrument every ``jax.jit(...)`` call made inside the block;
+    yields the monitor. Restores the real ``jax.jit`` on exit.
+    Programs jitted before the block stay uninstrumented — build the
+    engine inside the block for full coverage (the conftest fixture
+    wraps each test)."""
+    import jax
+
+    m = monitor if monitor is not None else RetraceMonitor()
+    orig_jit = jax.jit
+    here = __file__
+
+    def patched_jit(fun=None, *args, **kwargs):
+        if fun is None:
+            # decorator-with-options form: @jax.jit(static_argnums=...)
+            def deco(f):
+                return patched_jit(f, *args, **kwargs)
+            return deco
+        return orig_jit(m.wrap(fun, _alloc_site(here)), *args, **kwargs)
+
+    jax.jit = patched_jit
+    try:
+        yield m
+    finally:
+        jax.jit = orig_jit

@@ -256,19 +256,28 @@ def build_parser() -> argparse.ArgumentParser:
         "analyze",
         help="run the repo-native invariant analyzer over the package: "
              "closed-vocabulary contracts (fault sites, metrics, ledger "
-             "classes, alert kinds), the env contract, and concurrency "
-             "discipline; exits 1 on findings not in the baseline",
+             "classes, alert kinds), the env contract, concurrency "
+             "discipline, and JAX program contracts; exits 1 on "
+             "findings not in the baseline",
     )
     analyze.add_argument(
         "--json", dest="as_json", action="store_true",
-        help="emit the machine-readable findings payload (schema "
-             "version pinned by tests/test_analysis.py)",
+        help="emit the machine-readable findings payload with per-pass "
+             "wall times (schema version pinned by "
+             "tests/test_analysis.py)",
     )
     analyze.add_argument(
         "--pass", dest="passes", action="append",
-        choices=["contracts", "env", "concurrency"], metavar="NAME",
+        choices=["contracts", "env", "concurrency", "jaxcontract"],
+        metavar="NAME",
         help="run only this pass (repeatable; default: all of "
-             "contracts, env, concurrency)",
+             "contracts, env, concurrency, jaxcontract)",
+    )
+    analyze.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline file to suppress exactly the "
+             "current findings (atomic, sorted) and print an "
+             "added/removed diff summary to stderr; exits 0",
     )
     analyze.add_argument(
         "--root", metavar="DIR", default=None,
@@ -445,17 +454,36 @@ def main(argv: list[str] | None = None) -> int:
             Path(tpu_kubernetes.__file__).resolve().parent.parent
         passes = args.passes or list(analysis.PASS_NAMES)
         try:
-            findings = analysis.run_analysis(root, passes)
+            findings, timings = analysis.run_analysis_timed(root, passes)
             baseline_path = Path(args.baseline) if args.baseline \
                 else root / analysis.BASELINE_NAME
             baseline = analysis.load_baseline(baseline_path)
         except (analysis.ProjectError, SyntaxError, OSError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+        if args.update_baseline:
+            # rewrite the gate to suppress exactly the current findings,
+            # loudly: the added/removed entries are the review surface
+            want = sorted({f.key() for f in findings})
+            added = [k for k in want if k not in baseline]
+            removed = sorted(k for k in baseline if k not in set(want))
+            analysis.write_baseline(baseline_path, findings)
+            print(
+                f"baseline {baseline_path}: {len(want)} entr"
+                f"{'y' if len(want) == 1 else 'ies'} "
+                f"(+{len(added)} added, -{len(removed)} removed)",
+                file=sys.stderr,
+            )
+            for code, p, symbol in added:
+                print(f"  + {code} {p} [{symbol}]", file=sys.stderr)
+            for code, p, symbol in removed:
+                print(f"  - {code} {p} [{symbol}]", file=sys.stderr)
+            return 0
         new, old = analysis.split_baselined(findings, baseline)
         if args.as_json:
             print(json.dumps(
-                analysis.report_json(new, old, str(root), passes),
+                analysis.report_json(new, old, str(root), passes,
+                                     timings=timings),
                 indent=2, sort_keys=True,
             ))
         else:

@@ -32,7 +32,10 @@ program builders key on that invariant (rank >= 4 ⇒ shard axis 2 on
 the ``tensor`` mesh axis; everything else — tokens, page tables,
 ``SlotState`` — replicates), so the slot/paged primitives here run
 unchanged per shard under ``shard_map``. A new cache layout must either
-keep kv at axis 2 or teach ``kv_partition_spec`` its shape.
+keep kv at axis 2 or teach ``kv_partition_spec`` its shape. The
+``jaxcontract`` analyzer pass enforces the pin statically (``kv-axis-pin``,
+docs/guide/static-analysis.md), alongside the donation-safety and
+jit-purity contracts every program built over these caches relies on.
 
 MoE semantics: the routed layer runs per chunk (the prompt in prefill,
 one token per decode step), so expert-capacity dropping — whose threshold

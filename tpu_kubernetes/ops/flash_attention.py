@@ -410,7 +410,7 @@ def flash_attention(
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if use_pallas is None:
         use_pallas = _on_tpu()
-    OPS_TRACED.labels(
+    OPS_TRACED.labels(  # lint: jit-impure-ok — counts traces on purpose
         "flash_attention",
         "pallas" if use_pallas else ("interpret" if interpret
                                      else "reference"),

@@ -385,7 +385,7 @@ def grouped_matmul(
     if use_pallas is None:
         use_pallas = _on_tpu()
     kernel = pltpu is not None and (use_pallas or interpret)
-    OPS_TRACED.labels(
+    OPS_TRACED.labels(  # lint: jit-impure-ok — counts traces on purpose
         "grouped_matmul",
         ("pallas" if use_pallas else "interpret") if kernel
         else "reference",
@@ -405,7 +405,7 @@ def grouped_matmul(
                     "use_pallas=False was passed — leave it unset (or "
                     "True) for the kernel path"
                 )
-                print(
+                print(  # lint: jit-impure-ok — one-shot trace-time warning
                     "[grouped_matmul] WARNING: XLA reference fallback on "
                     f"a TPU backend (O(E*M*K*N) flops — every expert "
                     f"multiplies every row): {cause}.",

@@ -47,6 +47,15 @@ Works with raw bf16 params or the int8 export (models/quant.py): the
 quantized ``{"q", "s"}`` leaves carry the same logical axes as the
 weights they replace, scales sharded like the output channel they scale.
 
+The program contracts this module builds on — PartitionSpec axes inside
+the ``MESH_AXES`` vocabulary, ``kv_partition_spec`` keeping kv-heads at
+axis 2, donated buffers never read after the donating call, no host
+effects inside traced bodies — are enforced statically by the
+``jaxcontract`` analyzer pass, and the runtime retrace sentinel
+(``TPU_K8S_RETRACE=1``, ``make jax-check``) proves each builder's
+programs compile exactly once per input signature in steady state
+(docs/guide/static-analysis.md).
+
 The reference provisioner has no inference plane (SURVEY §0); this
 completes the serving side of the in-tree stack the same way
 make_sharded_train_step completes training (train/trainer.py:107).
