@@ -3,7 +3,7 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast analysis-check jax-check obs-check monitor-check flightrec-check alerts-check perf-check goodput-check serve-identity-check serve-continuous-check paged-check sharded-check resilience-check bench dryrun native dist dist-offline clean
+.PHONY: test test-fast analysis-check jax-check obs-check monitor-check flightrec-check alerts-check trace-check perf-check goodput-check serve-identity-check serve-continuous-check paged-check sharded-check resilience-check bench dryrun native dist dist-offline clean
 
 test:
 	python -m pytest tests/ -q
@@ -13,7 +13,7 @@ test:
 native:
 	python -c "from tpu_kubernetes import native; assert native.available(), 'native build failed'; print('native runtime OK')"
 
-test-fast: analysis-check jax-check
+test-fast: analysis-check jax-check trace-check
 	python -m pytest tests/ -q -m "not slow"
 
 # Invariant-analyzer gate: the AST contract passes (closed vocabularies,
@@ -43,7 +43,7 @@ jax-check: analysis-check
 # history store (tsdb), the fleet aggregator + SLO suite, plus a live
 # CPU server boot that scrapes GET /metrics and walks /debug/trace
 # (docs/guide/observability.md).
-obs-check:
+obs-check: trace-check
 	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py \
 	  tests/test_expfmt.py tests/test_tsdb.py tests/test_fleet_obs.py \
 	  tests/test_alerts.py tests/test_incidents.py \
@@ -89,6 +89,17 @@ flightrec-check:
 	  "tests/test_faults.py::test_flightrec_auto_dumps_on_engine_reset" \
 	  "tests/test_faults.py::test_flightrec_dumps_on_cold_restart" \
 	  "tests/test_faults.py::test_flightrec_http_endpoint_live" \
+	  -q -m "not slow"
+
+# Distributed-tracing gate: the traceparent/propagation/export units
+# (tests/test_tracing.py — including the two-live-server stitched-trace
+# test and the deterministic-sampling units) plus the export-chaos test
+# proving obs.trace_export at prob 1.0 drops spans silently, never a
+# request (docs/guide/observability.md "Distributed tracing &
+# saturation").
+trace-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py \
+	  "tests/test_faults.py::test_trace_export_chaos_drops_spans_silently" \
 	  -q -m "not slow"
 
 # Perf gate: the CPU-deterministic microbench suites (obs/perfbench.py)

@@ -161,3 +161,76 @@ def test_bucket_quantile_only_inf_bucket_is_none():
     # a histogram with no finite bounds at all has nothing to clamp to
     assert expfmt.bucket_quantile([(math.inf, 9.0)], 0.5) is None
     assert expfmt.bucket_quantile([(math.inf, 0.0)], 0.5) is None
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exemplars + # EOF (the distributed-tracing additions)
+# ---------------------------------------------------------------------------
+
+TID = "4bf92f3577b34da6a3ce929d0e0e4736"
+
+
+def _exemplar_registry() -> Registry:
+    reg = Registry()
+    h = reg.histogram("latency_seconds", "request latency",
+                      buckets=(0.1, 0.5, 1.0))
+    h.observe(0.05)
+    h.observe(0.3, exemplar=TID)         # exemplar lands on the 0.5 bucket
+    h.observe(2.0, exemplar="a" * 32)    # ... and one on +Inf
+    return reg
+
+
+def test_eof_marker_tolerated():
+    # OpenMetrics exposition ends with `# EOF`; parse must not choke
+    fams = expfmt.parse("x_total 1\n# EOF\n")
+    assert fams[0].samples[0].value == 1
+
+
+def test_exemplar_parses_into_sample():
+    text = _exemplar_registry().render()
+    assert '# {trace_id="' + TID + '"} 0.3' in text
+    fams = {f.name: f for f in expfmt.parse(text)}
+    with_ex = [s for s in fams["latency_seconds"].samples
+               if s.exemplar is not None]
+    assert len(with_ex) == 2
+    ex = next(s.exemplar for s in with_ex
+              if s.labels_dict()["le"] == "0.5")
+    assert ex.labels == (("trace_id", TID),)
+    assert ex.value == 0.3
+
+
+def test_exemplars_survive_round_trip():
+    text = _exemplar_registry().render()
+    assert expfmt.render(expfmt.parse(text)) == text
+    # and exemplars ride with_label (the aggregator's instance tagging)
+    sample = next(s for s in expfmt.parse(text)[0].samples
+                  if s.exemplar is not None)
+    tagged = sample.with_label("instance", "h:8000")
+    assert tagged.exemplar == sample.exemplar
+    assert '# {trace_id="' in expfmt.render_sample(tagged)
+
+
+def test_exemplar_free_input_round_trips_byte_identical():
+    # the pre-exemplar contract is untouched: no `# {` marker anywhere
+    text = _busy_registry().render()
+    assert " # {" not in text
+    assert expfmt.render(expfmt.parse(text)) == text
+
+
+def test_exemplar_marker_inside_quoted_label_not_split():
+    line = 'x_total{path="a # {b} c"} 1\n'
+    fams = expfmt.parse(line)
+    sample = fams[0].samples[0]
+    assert sample.labels_dict()["path"] == "a # {b} c"
+    assert sample.exemplar is None
+    assert expfmt.render_sample(sample) + "\n" == line
+
+
+@pytest.mark.parametrize("line", [
+    'x_total 1 # {trace_id="abc"',        # unterminated exemplar labels
+    'x_total 1 # {trace_id="abc"}',       # missing exemplar value
+    'x_total 1 # {no_equals} 2',          # malformed exemplar label
+])
+def test_malformed_exemplars_raise(line):
+    with pytest.raises(expfmt.ParseError):
+        expfmt.parse(line + "\n")

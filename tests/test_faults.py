@@ -99,6 +99,7 @@ def test_site_vocabulary_is_closed():
         "serve.prefill", "serve.slot_insert", "serve.segment",
         "serve.shard_segment", "serve.prefix_insert", "serve.page_alloc",
         "fleet.scrape", "shell.terraform", "obs.alert_sink",
+        "obs.trace_export",
     }
     assert ENV_VAR == "TPU_K8S_FAULTS"
 
@@ -250,6 +251,69 @@ def test_chaos_http_surface_stays_consistent(chaos_server):
     status, data = req("POST", "/v1/completions",
                        {"prompt": "pack my box", "max_new_tokens": 3})
     assert status == 200 and json.loads(data)["text"]
+
+
+def test_trace_export_chaos_drops_spans_silently(chaos_server, tmp_path):
+    """obs.trace_export at prob 1.0 never blocks or fails a request:
+    every completion succeeds with text, /healthz stays 200/ok, the
+    dropped batches are counted by tpu_trace_spans_dropped_total, and
+    the same exporter delivers again the moment faults clear."""
+    import http.client
+
+    from tpu_kubernetes.obs import tracing
+    from tpu_kubernetes.obs.tracing import SPANS_DROPPED, SPANS_EXPORTED
+
+    host, port = chaos_server.server_address[:2]
+    state = chaos_server.RequestHandlerClass.state
+
+    def req(method, path, body=None):
+        conn = http.client.HTTPConnection(host, port, timeout=120)
+        conn.request(
+            method, path,
+            body=None if body is None else json.dumps(body),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        return resp.status, data
+
+    spans_file = tmp_path / "spans.jsonl"
+    runtime = tracing.TraceRuntime(
+        tracing.TraceConfig(sample=1.0, export_path=str(spans_file)),
+    )
+    old_runtime = state.tracing
+    state.tracing = runtime              # arm a live export sink
+    try:
+        dropped_before = SPANS_DROPPED.value
+        with injected("obs.trace_export:1.0"):
+            for p in PROMPTS:
+                status, data = req("POST", "/v1/completions",
+                                   {"prompt": p, "max_new_tokens": 3})
+                assert status == 200 and json.loads(data)["text"]
+                h_status, h_data = req("GET", "/healthz")
+                assert h_status == 200
+                assert json.loads(h_data)["status"] == "ok"
+            # every accepted batch was ATTEMPTED (and dropped) while
+            # the fault was armed — flush is the test-only wait
+            assert runtime.exporter.flush(10.0)
+        assert SPANS_DROPPED.value > dropped_before
+        assert not spans_file.exists() or spans_file.read_text() == ""
+
+        # faults cleared: the same exporter delivers without a restart
+        exported_before = SPANS_EXPORTED.value
+        status, data = req("POST", "/v1/completions",
+                           {"prompt": "pack my box", "max_new_tokens": 3})
+        assert status == 200 and json.loads(data)["text"]
+        assert runtime.exporter.flush(10.0)
+        assert SPANS_EXPORTED.value > exported_before
+        recs = [json.loads(x)
+                for x in spans_file.read_text().splitlines()]
+        assert recs and all(r["trace"] for r in recs)
+        assert any(r["name"] == "request" for r in recs)
+    finally:
+        state.tracing = old_runtime
+        runtime.close()
 
 
 # ---------------------------------------------------------------------------

@@ -642,3 +642,104 @@ def test_alert_json_carries_since_age_and_burn_thresholds(two_workers):
     d = cycle(t0 + 120).to_dict()
     assert d["state"] == "firing"
     assert d["age_s"] == pytest.approx(60.0)
+
+
+# ---------------------------------------------------------------------------
+# per-role saturation + exemplars through the fleet pipeline (PR 17)
+# ---------------------------------------------------------------------------
+
+
+def _saturating_registry(role="prefill", occupancy=3.0, inflight=6,
+                         waits=(0.5, 0.5, 0.5, 0.5), pages=None) -> Registry:
+    """One worker under load: admission-wait observations, live slot
+    rows, queue depth, a SERVE_ROLE info gauge, optionally a paged-KV
+    page partition."""
+    reg = _serving_registry(ok=5, inflight=inflight)
+    reg.gauge("tpu_serve_role_info", "worker role (SERVE_ROLE)",
+              labelnames=("role",)).labels(role).set(1)
+    reg.gauge("tpu_serve_slot_occupancy", "live slot rows").set(occupancy)
+    aw = reg.histogram("tpu_serve_admission_wait_seconds", "admission wait",
+                       buckets=(0.01, 0.1, 1.0))
+    for v in waits:
+        aw.observe(v)
+    if pages:
+        pg = reg.gauge("tpu_serve_kv_pages", "page partition",
+                       labelnames=("state",))
+        for state, n in pages.items():
+            pg.labels(state).set(n)
+    return reg
+
+
+def test_saturation_gauge_carries_role_label():
+    w = _Exporter(_saturating_registry(role="prefill"))
+    try:
+        agg = FleetAggregator([w.target])
+        snap = agg.scrape_once(now=1000.0)
+        (sample,) = snap.families["tpu_serve_saturation"].samples
+        d = sample.labels_dict()
+        assert d["instance"] == w.target and d["role"] == "prefill"
+        # first cycle: the EWMA seeds from the full absolutes (0.5s mean
+        # wait -> ewma 0.15 -> 0.375); occupancy 3/(3+2)=0.6 dominates
+        # inflight 6/(6+8); the score is the max component
+        assert sample.value == pytest.approx(0.6, abs=1e-6)
+        # a second cycle with no new observations keeps the EWMA steady
+        (again,) = agg.scrape_once(now=1010.0) \
+            .families["tpu_serve_saturation"].samples
+        assert again.value == pytest.approx(0.6, abs=1e-6)
+    finally:
+        w.stop()
+
+
+def test_saturation_page_pressure_component():
+    w = _Exporter(_saturating_registry(
+        role="decode", occupancy=0.0, inflight=0, waits=(),
+        pages={"free": 2, "used": 18},
+    ))
+    try:
+        snap = FleetAggregator([w.target]).scrape_once(now=1.0)
+        (sample,) = snap.families["tpu_serve_saturation"].samples
+        assert sample.labels_dict()["role"] == "decode"
+        assert sample.value == pytest.approx(0.9, abs=1e-6)  # 1 - 2/20
+    finally:
+        w.stop()
+
+
+def test_monitor_rows_and_table_surface_role_and_saturation():
+    w = _Exporter(_saturating_registry(role="prefill"))
+    try:
+        snap = FleetAggregator([w.target]).scrape_once(now=1.0)
+        (row,) = fleet_rows(snap)
+        assert row["role"] == "prefill"
+        assert row["saturation"] == pytest.approx(0.6, abs=1e-6)
+        table = render_table([row], [])
+        assert "ROLE" in table and "SAT" in table
+        assert "prefill" in table and "0.600" in table
+    finally:
+        w.stop()
+
+
+def test_exemplars_survive_scrape_merge_reexpose():
+    tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+    reg = _serving_registry(latencies=(0.05,))
+    lat = reg.histogram("tpu_serve_request_seconds", "latency",
+                        labelnames=("endpoint",),
+                        buckets=(0.1, 0.5, 1.0))  # get-or-create: same family
+    lat.labels("/v1/completions").observe(0.3, exemplar=tid)
+    w = _Exporter(reg)
+    try:
+        snap = FleetAggregator([w.target]).scrape_once(now=1.0)
+        text = snap.render()
+        # the aggregator re-exposes the worker's exemplar verbatim...
+        assert f'# {{trace_id="{tid}"}} 0.3' in text
+        # ...still attached to the instance-tagged bucket sample, and the
+        # re-exposed text parses back with the exemplar intact
+        sample = next(
+            s for f in expfmt.parse(text)
+            if f.name == "tpu_serve_request_seconds"
+            for s in f.samples if s.exemplar is not None
+        )
+        assert sample.labels_dict()["instance"] == w.target
+        assert sample.exemplar.labels == (("trace_id", tid),)
+        assert sample.exemplar.value == pytest.approx(0.3)
+    finally:
+        w.stop()

@@ -84,7 +84,7 @@ def build_parser() -> argparse.ArgumentParser:
         "kind",
         choices=["manager", "cluster", "kubeconfig", "runs", "metrics",
                  "profile", "goodput", "history", "flightrec", "alerts",
-                 "incidents"],
+                 "incidents", "trace"],
         help="profile renders the worker's phase table — cold (prefill) "
              "vs warm (prefill_warm) prefills split out, so prefix-cache "
              "savings are read off one row pair; goodput renders the "
@@ -95,12 +95,16 @@ def build_parser() -> argparse.ArgumentParser:
              "flightrec renders the engine's live black box "
              "(GET /debug/flightrec); alerts renders the worker's rule "
              "alerts and silences (GET /debug/alerts); incidents lists "
-             "local incident bundles (see --dir)",
+             "local incident bundles (see --dir); trace stitches one "
+             "distributed trace's spans from every --targets instance "
+             "into a cross-instance tree with a critical-path breakdown",
     )
     get.add_argument(
         "metric", nargs="?", metavar="METRIC",
         help="with history: the metric family to query "
-             "(e.g. tpu_serve_requests_total)",
+             "(e.g. tpu_serve_requests_total); with trace: the trace id "
+             "to stitch (32 hex chars, from a traceparent header or a "
+             "latency exemplar)",
     )
     get.add_argument(
         "--manager", metavar="NAME",
@@ -124,8 +128,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     get.add_argument(
         "--targets", metavar="HOST:PORT[,HOST:PORT...]", default=None,
-        help="with history: comma-separated worker endpoints to scrape "
-             "(default: the --target value)",
+        help="with history/trace: comma-separated worker endpoints to "
+             "query (default: the --target value)",
     )
     get.add_argument(
         "--window", type=float, default=60.0, metavar="SECONDS",
@@ -338,6 +342,46 @@ def main(argv: list[str] | None = None) -> int:
             samples=args.samples, interval=args.interval,
             as_json=args.as_json,
         )
+
+    if args.command == "get" and args.kind == "trace":
+        # stitch one distributed trace across the fleet: every --targets
+        # instance is asked for GET /debug/trace/<id>; instances that
+        # never saw the trace (404) or are down just drop out of the
+        # stitch — one live instance with spans is enough to render
+        from tpu_kubernetes.obs import tracing
+
+        if not args.metric:
+            print("error: get trace needs a trace id "
+                  "(32 hex chars, e.g. from a traceparent header)",
+                  file=sys.stderr)
+            return 2
+        raw = args.targets if args.targets else args.target
+        targets = [t.strip() for t in raw.split(",") if t.strip()]
+        if not targets:
+            print("error: get trace needs at least one target",
+                  file=sys.stderr)
+            return 2
+        payloads: dict[str, dict] = {}
+        errors: list[str] = []
+        for target in targets:
+            try:
+                payloads[target] = tracing.fetch_trace(target, args.metric)
+            except Exception as e:  # noqa: BLE001 — skip dead/unaware instances
+                errors.append(f"{target}: {e}")
+        if not payloads:
+            print(f"error: no instance returned trace {args.metric}:",
+                  file=sys.stderr)
+            for line in errors:
+                print(f"  {line}", file=sys.stderr)
+            return 1
+        stitched = tracing.stitch_trace(args.metric, payloads)
+        if args.as_json:
+            print(json.dumps(stitched, indent=2, sort_keys=True))
+        else:
+            print(tracing.render_trace(stitched), end="")
+            for line in errors:
+                print(f"  (no data: {line})", file=sys.stderr)
+        return 0
 
     if args.command == "get" and args.kind == "flightrec":
         # a remote worker's GET /debug/flightrec, rendered — same

@@ -27,7 +27,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field, replace
 from typing import Callable
 
-from tpu_kubernetes.obs import expfmt
+from tpu_kubernetes.obs import expfmt, tracing
 from tpu_kubernetes.obs.faults import FAULTS
 
 # synthetic per-target families the aggregator itself contributes
@@ -39,6 +39,15 @@ SCRAPE_BACKOFF = "fleet_scrape_backoff_seconds"
 # exponential backoff cap, as a multiple of the base interval: a target
 # that stays dead is re-polled at ~8x the normal period, not never
 BACKOFF_CAP_MULT = 8.0
+
+# per-instance saturation score: each component maps to [0, 1) via
+# x / (x + half), where ``half`` is the reading at which the component
+# scores 0.5 — the score is the MAX component (the binding constraint),
+# which is what a placement decision actually routes away from
+SAT_WAIT_HALF_S = 0.25   # admission-wait EWMA seconds scoring 0.5
+SAT_QUEUE_HALF = 8.0     # inflight requests scoring 0.5
+SAT_SLOTS_HALF = 2.0     # mean live slot rows scoring 0.5
+SAT_EWMA_ALPHA = 0.3     # per-cycle smoothing of the admission wait
 
 
 @dataclass
@@ -193,10 +202,18 @@ class FleetAggregator:
             instance: TargetHealth(instance=instance)
             for instance, _ in self._targets
         }
+        # admission-wait EWMA state per instance (scrape_once only —
+        # single-writer, so it lives outside the health lock)
+        self._sat_state: dict[str, dict] = {}
 
     def _fetch(self, url: str) -> str:
+        # every outbound scrape carries W3C trace context — the scrape
+        # itself becomes a span in the worker's ring, so a slow /metrics
+        # endpoint is attributable like any other request
         req = urllib.request.Request(
-            url, headers={"Accept": "text/plain", "User-Agent": "tpu-k8s-monitor"}
+            url, headers=tracing.outbound_headers({
+                "Accept": "text/plain", "User-Agent": "tpu-k8s-monitor",
+            }),
         )
         with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
             return resp.read().decode("utf-8", "replace")
@@ -223,6 +240,81 @@ class FleetAggregator:
     def health(self) -> dict[str, TargetHealth]:
         with self._lock:
             return {i: replace(h) for i, h in self._health.items()}
+
+    def _saturation_family(self, merged: dict, health: dict, *,
+                           metric: str) -> expfmt.Family:
+        """Per-instance saturation in [0, 1): the MAX of four component
+        pressures — admission-wait EWMA (delta mean per cycle, smoothed
+        by SAT_EWMA_ALPHA), mean live slot rows, free-page fraction
+        (paged engines), and inflight queue depth — each squashed via
+        ``x / (x + half)``. The ``role`` label joins the worker's
+        ``tpu_serve_role_info`` gauge (SERVE_ROLE), so disaggregated
+        prefill/decode tiers balance independently."""
+
+        def val(family: str, sample_name: str, instance: str,
+                extra: dict | None = None) -> float:
+            fam = merged.get(family)
+            if fam is None:
+                return 0.0
+            out = 0.0
+            for s in fam.samples:
+                if s.name != sample_name:
+                    continue
+                d = s.labels_dict()
+                if d.get("instance") != instance:
+                    continue
+                if extra and any(d.get(k) != v for k, v in extra.items()):
+                    continue
+                out += s.value
+            return out
+
+        def role_of(instance: str) -> str:
+            fam = merged.get("tpu_serve_role_info")
+            if fam is not None:
+                for s in fam.samples:
+                    d = s.labels_dict()
+                    if d.get("instance") == instance and "role" in d:
+                        return d["role"]
+            return ""
+
+        aw = "tpu_serve_admission_wait_seconds"
+        samples = []
+        for i in sorted(health):
+            wsum = val(aw, aw + "_sum", i)
+            wcount = val(aw, aw + "_count", i)
+            st = self._sat_state.get(i) or \
+                {"sum": 0.0, "count": 0.0, "ewma": 0.0}
+            dsum, dcount = wsum - st["sum"], wcount - st["count"]
+            if dsum < 0 or dcount < 0:   # counter reset (worker restart)
+                dsum, dcount = wsum, wcount
+            if dcount > 0:
+                st["ewma"] = (SAT_EWMA_ALPHA * (dsum / dcount)
+                              + (1.0 - SAT_EWMA_ALPHA) * st["ewma"])
+            st["sum"], st["count"] = wsum, wcount
+            self._sat_state[i] = st
+            wait_p = st["ewma"] / (st["ewma"] + SAT_WAIT_HALF_S)
+            occ = val("tpu_serve_slot_occupancy",
+                      "tpu_serve_slot_occupancy", i)
+            occ_p = occ / (occ + SAT_SLOTS_HALF) if occ > 0 else 0.0
+            q = val("tpu_serve_inflight_requests",
+                    "tpu_serve_inflight_requests", i)
+            q_p = q / (q + SAT_QUEUE_HALF) if q > 0 else 0.0
+            pages = val("tpu_serve_kv_pages", "tpu_serve_kv_pages", i)
+            free = val("tpu_serve_kv_pages", "tpu_serve_kv_pages", i,
+                       {"state": "free"})
+            page_p = (1.0 - free / pages) if pages > 0 else 0.0
+            samples.append(expfmt.Sample(
+                name=metric,
+                labels=(("instance", i), ("role", role_of(i))),
+                value=round(max(wait_p, occ_p, q_p, page_p), 6),
+            ))
+        return expfmt.Family(
+            name=metric, kind="gauge",
+            help="per-instance saturation score in [0,1): max of "
+                 "admission-wait EWMA, slot occupancy, page pressure, "
+                 "and queue-depth components (role joins SERVE_ROLE)",
+            samples=samples,
+        )
 
     def scrape_once(self, now: float | None = None) -> FleetSnapshot:
         """One fleet cycle: scrape every target concurrently, update
@@ -309,6 +401,10 @@ class FleetAggregator:
                     for i in sorted(health)
                 ],
             )
+        sat = self._saturation_family(
+            merged, health, metric="tpu_serve_saturation",
+        )
+        merged[sat.name] = sat
         snapshot = FleetSnapshot(ts=now, health=health, families=merged)
         if self._tsdb is not None:
             try:

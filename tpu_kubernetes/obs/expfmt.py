@@ -27,14 +27,27 @@ class ParseError(ValueError):
 
 
 @dataclass
+class Exemplar:
+    """An OpenMetrics exemplar riding a sample line (``# {…} value``):
+    the trace-id labels and the observed value that landed in that
+    bucket — how a p99 bucket points at a real slow trace."""
+
+    labels: tuple[tuple[str, str], ...]
+    value: float
+
+
+@dataclass
 class Sample:
     """One exposed time series value. ``name`` is the full sample name
     (``foo_bucket``, ``foo_sum``, … for histogram rows); ``labels`` keeps
-    the rendered pair order so re-emission is byte-identical."""
+    the rendered pair order so re-emission is byte-identical. An
+    exemplar, when present, survives parse → merge → render untouched
+    (``with_label`` copies carry it via ``replace``)."""
 
     name: str
     labels: tuple[tuple[str, str], ...]
     value: float
+    exemplar: Exemplar | None = None
 
     def labels_dict(self) -> dict[str, str]:
         return dict(self.labels)
@@ -137,6 +150,58 @@ def _parse_labels(raw: str, line: str) -> tuple[tuple[str, str], ...]:
     return tuple(pairs)
 
 
+def _split_exemplar(line: str) -> tuple[str, str]:
+    """Split an OpenMetrics exemplar suffix (`` # {…} value``) off a
+    sample line, honoring quotes — a label *value* containing the
+    marker must not trigger the split. Returns (body, raw_exemplar);
+    raw_exemplar is "" when the line carries none."""
+    in_quotes = False
+    i = 0
+    while i < len(line):
+        c = line[i]
+        if in_quotes:
+            if c == "\\":
+                i += 2
+                continue
+            if c == '"':
+                in_quotes = False
+        elif c == '"':
+            in_quotes = True
+        elif c == "#" and line.startswith(" # {", i - 1):
+            return line[:i - 1], line[i + 2:]
+        i += 1
+    return line, ""
+
+
+def _parse_exemplar(raw: str, line: str) -> Exemplar:
+    """``{labels} value [timestamp]`` → :class:`Exemplar`."""
+    if not raw.startswith("{"):
+        raise ParseError(f"malformed exemplar in line {line!r}")
+    # quote-aware scan for the closing brace
+    in_quotes = False
+    j = 1
+    while j < len(raw):
+        c = raw[j]
+        if in_quotes:
+            if c == "\\":
+                j += 2
+                continue
+            if c == '"':
+                in_quotes = False
+        elif c == '"':
+            in_quotes = True
+        elif c == "}":
+            break
+        j += 1
+    else:
+        raise ParseError(f"unterminated exemplar in line {line!r}")
+    labels = _parse_labels(raw[1:j], line)
+    rest = raw[j + 1:].strip()
+    if not rest:
+        raise ParseError(f"exemplar missing value in line {line!r}")
+    return Exemplar(labels=labels, value=parse_value(rest.split(" ")[0]))
+
+
 def _base_name(sample_name: str, families: dict[str, Family]) -> str:
     """Histogram rows are exposed under ``<family>_bucket/_sum/_count``;
     map a sample name back to the family that declared it."""
@@ -174,25 +239,32 @@ def parse(text: str) -> list[Family]:
                 family(parts[2]).kind = (
                     parts[3].strip() if len(parts) > 3 else "untyped"
                 )
-            # other comments are legal exposition — ignored
+            # other comments (OpenMetrics `# EOF` included) are legal
+            # exposition — ignored
             continue
-        brace = line.find("{")
+        # an OpenMetrics exemplar suffix must come off before the
+        # rfind("}") below — its braces would corrupt the label scan
+        body, raw_exemplar = _split_exemplar(line)
+        exemplar = _parse_exemplar(raw_exemplar, line) if raw_exemplar \
+            else None
+        brace = body.find("{")
         if brace >= 0:
-            close = line.rfind("}")
+            close = body.rfind("}")
             if close < brace:
                 raise ParseError(f"malformed sample line {line!r}")
-            name = line[:brace]
-            labels = _parse_labels(line[brace + 1:close], line)
-            rest = line[close + 1:].strip()
+            name = body[:brace]
+            labels = _parse_labels(body[brace + 1:close], line)
+            rest = body[close + 1:].strip()
         else:
-            name, _, rest = line.partition(" ")
+            name, _, rest = body.partition(" ")
             labels = ()
             rest = rest.strip()
         if not name or not rest:
             raise ParseError(f"malformed sample line {line!r}")
         value = parse_value(rest.split(" ")[0])  # a timestamp may follow
         family(_base_name(name, families)).samples.append(
-            Sample(name=name, labels=labels, value=value)
+            Sample(name=name, labels=labels, value=value,
+                   exemplar=exemplar)
         )
     return [families[n] for n in order]
 
@@ -202,7 +274,13 @@ def render_sample(sample: Sample) -> str:
         f'{k}="{_escape_label(v)}"' for k, v in sample.labels
     )
     body = "{" + labels + "}" if labels else ""
-    return f"{sample.name}{body} {format_value(sample.value)}"
+    line = f"{sample.name}{body} {format_value(sample.value)}"
+    if sample.exemplar is not None:
+        ex_labels = ",".join(
+            f'{k}="{_escape_label(v)}"' for k, v in sample.exemplar.labels
+        )
+        line += " # {" + ex_labels + "} " + format_value(sample.exemplar.value)
+    return line
 
 
 def render(families: list[Family]) -> str:

@@ -53,6 +53,7 @@ SITES = frozenset({
     "fleet.scrape",         # FleetAggregator per-target fetch
     "shell.terraform",      # TerraformExecutor subprocess run
     "obs.alert_sink",       # alert notification delivery (obs/alerts.py)
+    "obs.trace_export",     # span exporter delivery (obs/tracing.py)
 })
 
 FAULTS_INJECTED = REGISTRY.counter(

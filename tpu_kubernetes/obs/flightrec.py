@@ -344,6 +344,14 @@ def render_flightrec(payload: dict) -> str:
             f", admitted={seg.get('admitted')}, reaped={seg.get('reaped')}"
             f", queued={seg.get('queued')}"
         )
+        traces = seg.get("trace_ids")
+        if traces:
+            shown = ", ".join(traces[:4])
+            more = len(traces) - 4
+            lines.append(
+                "  traces resident: " + shown
+                + (f" (+{more} more)" if more > 0 else "")
+            )
         pages = seg.get("pages")
         if pages:
             lines.append(

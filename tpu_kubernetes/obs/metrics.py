@@ -111,21 +111,26 @@ class _GaugeChild(_Child):
 
 
 class _HistogramChild(_Child):
-    __slots__ = ("counts", "sum", "count")
+    __slots__ = ("counts", "sum", "count", "exemplars")
 
     def __init__(self, family: _Family):
         super().__init__(family)
         self.counts = [0] * (len(family.buckets) + 1)  # +1: the +Inf bucket
         self.sum = 0.0
         self.count = 0
+        # bucket index -> (trace_id, observed value): the latest exemplar
+        # per bucket, so a p99 bucket links to a real slow trace
+        self.exemplars: dict[int, tuple[str, float]] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: str | None = None) -> None:
         v = float(value)
         i = bisect_left(self._family.buckets, v)
         with self._family._lock:
             self.counts[i] += 1
             self.sum += v
             self.count += 1
+            if exemplar:
+                self.exemplars[i] = (str(exemplar), v)
 
 
 _CHILD_TYPES = {
@@ -198,8 +203,8 @@ class _Family:
     def set(self, value: float) -> None:
         self._solo().set(value)
 
-    def observe(self, value: float) -> None:
-        self._solo().observe(value)
+    def observe(self, value: float, exemplar: str | None = None) -> None:
+        self._solo().observe(value, exemplar)
 
     @property
     def value(self) -> float:
@@ -217,15 +222,24 @@ class _Family:
             for key, child in items:
                 if self.kind == "histogram":
                     acc = 0
-                    for bound, n in zip(
+                    for i, (bound, n) in enumerate(zip(
                         (*self.buckets, math.inf), child.counts
-                    ):
+                    )):
                         acc += n
                         le = _format_value(bound)
                         labels = _label_str(
                             self.labelnames, key, extra=f'le="{le}"'
                         )
-                        lines.append(f"{self.name}_bucket{labels} {acc}")
+                        line = f"{self.name}_bucket{labels} {acc}"
+                        ex = child.exemplars.get(i)
+                        if ex is not None:
+                            # OpenMetrics exemplar: trace id riding the
+                            # bucket the observation landed in
+                            line += (
+                                f' # {{trace_id="{ex[0]}"}} '
+                                f"{_format_value(ex[1])}"
+                            )
+                        lines.append(line)
                     labels = _label_str(self.labelnames, key)
                     lines.append(
                         f"{self.name}_sum{labels} {_format_value(child.sum)}"

@@ -42,6 +42,8 @@ TOKENS_EMITTED = "tpu_serve_tokens_emitted_total"
 INFLIGHT = "tpu_serve_inflight_requests"
 KV_FREE_PAGES = "tpu_serve_kv_pages"
 BUILD_INFO = "tpu_k8s_build_info"
+ROLE_INFO = "tpu_serve_role_info"
+SATURATION = "tpu_serve_saturation"
 
 # how many slots each sparkline column renders (one char per slot)
 SPARK_BINS = 8
@@ -116,6 +118,14 @@ def fleet_rows(snapshot: FleetSnapshot,
             # per-instance build version (tpu_k8s_build_info) — a mixed
             # column during a rollout is the point of carrying it here
             "version": snapshot.label_value(BUILD_INFO, "version", mine),
+            # the worker's SERVE_ROLE tier and the aggregator's
+            # saturation score — what a disagg-aware balancer reads
+            "role": snapshot.label_value(ROLE_INFO, "role", mine),
+            "saturation": next(
+                (s.value
+                 for s in snapshot._samples(SATURATION, SATURATION, mine)),
+                None,
+            ),
             "consecutive_failures": health.consecutive_failures,
             "scrape_seconds": health.last_scrape_seconds,
             "error": health.last_error,
@@ -170,8 +180,9 @@ def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
     there since the tracker rows already show them)."""
     with_trends = any("spark" in row for row in rows)
     header = (
-        f"{'INSTANCE':<24} {'UP':>2} {'VER':>8} {'RPS':>8} {'P50':>8} "
-        f"{'P99':>8} {'TTFT99':>8} {'TOK/S':>8} {'QUEUE':>6} {'GOODPUT':>8}"
+        f"{'INSTANCE':<24} {'UP':>2} {'VER':>8} {'ROLE':>8} {'RPS':>8} "
+        f"{'P50':>8} {'P99':>8} {'TTFT99':>8} {'TOK/S':>8} {'QUEUE':>6} "
+        f"{'SAT':>6} {'GOODPUT':>8}"
     )
     if with_trends:
         header += (
@@ -187,12 +198,14 @@ def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
         line = (
             f"{row['instance']:<24} {row['up']:>2}"
             f" {(row.get('version') or '-'):>8}"
+            f" {(row.get('role') or '-'):>8}"
             f"{_fmt(row['rps'])}"
             f"{_fmt(row['p50_s'], 's', 9)}"
             f"{_fmt(row['p99_s'], 's', 9)}"
             f"{_fmt(row['ttft_p99_s'], 's', 9)}"
             f"{_fmt(row['tokens_per_s'])}"
             f"{_fmt(int(row['queue_depth']), '', 7)}"
+            f"{_fmt(row.get('saturation'), '', 7)}"
             f"{_fmt(row.get('goodput'), '', 9)}"
         )
         if with_trends:

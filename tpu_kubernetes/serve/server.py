@@ -122,6 +122,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tpu_kubernetes.obs import REGISTRY, events
 from tpu_kubernetes.obs import metrics as obs_metrics
+from tpu_kubernetes.obs import tracing
 from tpu_kubernetes.obs.faults import FAULTS
 from tpu_kubernetes.obs.ledger import LEDGER
 from tpu_kubernetes.obs.profile import PhaseProfiler
@@ -278,6 +279,16 @@ PROFILER = PhaseProfiler(
     help="device-synced serving phase seconds (mode=compile is a "
          "program's first call including trace+compile; mode=execute "
          "is steady state)",
+)
+# the *_info idiom (register_build_info): constant-1 gauge whose payload
+# is the label — the fleet aggregator joins this onto its saturation
+# family and the monitor's ROLE column, so disaggregated tiers
+# (SERVE_ROLE=prefill / decode / …) balance independently
+ROLE_INFO = REGISTRY.gauge(
+    "tpu_serve_role_info",
+    "this instance's serving role (SERVE_ROLE; constant 1, the role "
+    "rides the label)",
+    labelnames=("role",),
 )
 
 
@@ -676,6 +687,10 @@ class _ContinuousEngine:
             "budget": max_new, "deadline": deadline, "cancel": cancel,
             "event": threading.Event(), "dispatched": threading.Event(),
             "tokens": None, "error": None,
+            # the submitter's distributed trace id (contextvar, set by
+            # the handler) — segment spans link to every resident
+            # request's trace, and histogram exemplars cite it
+            "trace": tracing.current_trace_id(),
         }
         with self._cond:
             self._queue.append(entry)
@@ -905,7 +920,8 @@ class _ContinuousEngine:
                     self._cache = ins(self._cache, row, slot)
         entry["_device_s"] = time.perf_counter() - t0
         wait = time.monotonic() - entry["t_enq"]
-        ADMISSION_WAIT.observe(wait)
+        entry["_wait"] = wait    # rides the request's batch-span meta
+        ADMISSION_WAIT.observe(wait, exemplar=entry.get("trace") or None)
         st.admission.observe_service(wait)
         if entry["tokens"] is not None:
             entry["dispatched"].set()
@@ -1297,6 +1313,13 @@ class _ContinuousEngine:
             # dead share (empty slots, frozen rows) settles as bubble
             entry["_device_s"] = (entry.get("_device_s") or 0.0) + \
                 elapsed * emitted / row_steps
+        # the traces this segment served — captured BEFORE the drain
+        # below retires finished rows, so a request that completes in
+        # this very segment still links to it
+        seg_traces = sorted({
+            e["trace"] for e in self._entries
+            if e is not None and e.get("trace")
+        })
         drained = 0
         if st.ready:
             # production: the device ran steps x slots row-steps; rows
@@ -1325,6 +1348,20 @@ class _ContinuousEngine:
                     "emitted_delta": row_steps if st.ready else 0,
                     "unsettled": LEDGER.unsettled(),
                 },
+                # postmortem ↔ trace cross-ref: the resident requests'
+                # distributed trace ids at segment time
+                trace_ids=seg_traces,
+            )
+        if seg_traces:
+            # one segment serves many requests: a span with LINKS to
+            # every resident trace, so `get trace <id>` renders the
+            # decode segments a request rode (annotated with the
+            # segment's device-seconds and ledger token classes)
+            TRACER.record(
+                "segment", elapsed, links=seg_traces,
+                steps=steps, live_steps=live, drained=drained,
+                device_s=round(elapsed, 6),
+                tokens_live=live, tokens_bubble=row_steps - live,
             )
         if st.ready:
             LEDGER.segment(
@@ -1505,6 +1542,18 @@ class ServingState:
         self.eos_id = int(eos_env) if eos_env else None
         self.model_name = env.get("SERVE_HF_CHECKPOINT", "") or env.get(
             "SERVE_MODEL", "llama-test"
+        )
+        # SERVE_ROLE: this instance's tier in a disaggregated fleet
+        # (e.g. prefill / decode). Rides the tpu_serve_role_info gauge,
+        # which the aggregator joins onto tpu_serve_saturation and the
+        # monitor shows as the ROLE column.
+        self.role = (env.get("SERVE_ROLE", "") or "").strip() or "serve"
+        ROLE_INFO.labels(self.role).set(1.0)
+        # distributed tracing (obs/tracing.py): inbound traceparent
+        # extraction, head+tail sampling, and the bounded background
+        # span exporter — all knobs via TPU_K8S_TRACE_*
+        self.tracing = tracing.TraceRuntime(
+            tracing.TraceConfig.from_env(env)
         )
         self._lock = threading.Lock()
         self._jax = jax
@@ -2620,6 +2669,7 @@ class ServingState:
         greedy_default = _is_greedy(temperature, top_k, top_p)
         spec = None
         ledger_device_s = 0.0
+        batch_span = None    # annotated with ledger token classes below
         if self.prompt_lookup and greedy_default:
             # draft-free speculation: tokens are exactly the greedy
             # decode at this cache span, EOS-trimmed by the loop
@@ -2643,8 +2693,17 @@ class ServingState:
             entry = self._engine.enqueue(ids, max_new, deadline=deadline)
             with TRACER.phase("queue", quiet=True):
                 entry["dispatched"].wait()
-            with TRACER.phase("batch", quiet=True, mode="continuous"):
+            with TRACER.phase("batch", quiet=True,
+                              mode="continuous") as batch_span:
                 tokens = _Batcher.result(entry)
+                # the critical-path annotations `get trace` surfaces:
+                # slot-admission wait and this row's device-second share
+                batch_span.meta["admission_wait_s"] = round(
+                    entry.get("_wait") or 0.0, 6
+                )
+                batch_span.meta["device_s"] = round(
+                    entry.get("_device_s") or 0.0, 6
+                )
             ledger_device_s = entry.get("_device_s") or 0.0
         elif self._batcher is not None and greedy_default:
             # greedy rows coalesce without changing output, by the
@@ -2721,6 +2780,12 @@ class ServingState:
                 "useful", delivered=len(tokens), decoded=decoded,
                 device_s=ledger_device_s,
             )
+        if batch_span is not None:
+            # ledger token classes on the span the trace view renders:
+            # delivered tokens vs the budget/EOS trims (bubble)
+            batch_span.meta["tokens"] = {
+                "useful": len(tokens), "trimmed": decoded - len(tokens),
+            }
         with TRACER.phase("decode", quiet=True, tokens=len(tokens)):
             text = self.decode_text(tokens)
         result = {
@@ -2950,6 +3015,12 @@ class _Handler(BaseHTTPRequestHandler):
         rid = getattr(self, "_rid", "")
         if rid:
             self.send_header("X-Request-Id", rid)
+        # … and the W3C trace context (our span id as the parent the
+        # caller's collector stitches under)
+        tctx = getattr(self, "_trace", None)
+        if tctx is not None:
+            self.send_header(tracing.TRACEPARENT,
+                             tracing.render_traceparent(tctx))
 
     @contextlib.contextmanager
     def _observed(self):
@@ -2964,23 +3035,41 @@ class _Handler(BaseHTTPRequestHandler):
         endpoint = self._endpoint()
         inbound = (self.headers.get("X-Request-Id") or "").strip()
         self._rid = inbound[:64] or events.new_id()
+        # W3C trace context: continue the caller's trace (deterministic
+        # head sampling means every instance agrees) or mint a root;
+        # the contextvar scope carries it into the engine queue and the
+        # SSE producer thread, send_response echoes it back
+        self._trace = self.state.tracing.extract(
+            self.headers.get(tracing.TRACEPARENT)
+        )
         self._code = 500
         self._t0 = time.monotonic()
         INFLIGHT.inc()
         try:
             with events.run_context(self._rid):
-                try:
-                    with TRACER.phase("request", quiet=True,
-                                      endpoint=endpoint):
-                        yield
-                finally:
-                    events.emit("http_request", path=self.path,
-                                code=getattr(self, "_code", 0))
+                with tracing.trace_scope(self._trace):
+                    try:
+                        with TRACER.phase("request", quiet=True,
+                                          endpoint=endpoint,
+                                          trace=self._trace.trace_id):
+                            yield
+                    finally:
+                        events.emit("http_request", path=self.path,
+                                    code=getattr(self, "_code", 0))
         finally:
             INFLIGHT.dec()
             REQUESTS_TOTAL.labels(endpoint, str(self._code)).inc()
+            wall = time.monotonic() - self._t0
             REQUEST_SECONDS.labels(endpoint).observe(
-                time.monotonic() - self._t0
+                wall,
+                exemplar=(self._trace.trace_id
+                          if self._trace.sampled else None),
+            )
+            # head/tail export decision + span hand-off to the bounded
+            # exporter — never blocks, never raises into the handler
+            self.state.tracing.finish_request(
+                TRACER, self._rid, self._trace,
+                code=self._code, wall_s=wall,
             )
 
     def _get(self):  # noqa: C901 — one dispatch ladder
@@ -3049,13 +3138,20 @@ class _Handler(BaseHTTPRequestHandler):
             # response's X-Request-Id header carried
             rid = self.path[len("/debug/trace/"):]
             tree = span_tree(TRACER.spans, rid)
-            if not tree:
-                return self._json(404, {
-                    "error": f"no spans recorded for run {rid!r}",
-                    "hint": "pass an X-Request-Id a response returned; "
-                            "old runs age out of the span ring",
-                })
-            return self._json(200, {"run": rid, "spans": tree})
+            if tree:
+                return self._json(200, {"run": rid, "spans": tree})
+            # not a run id — try it as a DISTRIBUTED trace id: every
+            # run whose request span carried it, plus the scheduler
+            # segment spans linked to it (`get trace` stitches these
+            # payloads across instances)
+            payload = tracing.trace_payload(TRACER.spans, rid)
+            if payload["spans"] or payload["segments"]:
+                return self._json(200, payload)
+            return self._json(404, {
+                "error": f"no spans recorded for run {rid!r}",
+                "hint": "pass an X-Request-Id a response returned; "
+                        "old runs age out of the span ring",
+            })
         if self.path != "/healthz":
             return self._json(404, {"error": "unknown path"})
         if st.failed:
@@ -3216,7 +3312,11 @@ class _Handler(BaseHTTPRequestHandler):
                     pieces = st.stream(prompt, finish=finish,
                                        cancel=cancel, **kwargs)
                     first = next(pieces, None)
-                    TTFT_SECONDS.observe(time.monotonic() - self._t0)
+                    TTFT_SECONDS.observe(
+                        time.monotonic() - self._t0,
+                        exemplar=(self._trace.trace_id
+                                  if self._trace.sampled else None),
+                    )
                     stream_ctx = (first, pieces, finish, cancel)
                 else:
                     result = st.complete(prompt, **kwargs)

@@ -96,6 +96,29 @@ class Tracer:
             if show:
                 print(f"[tpu-k8s] ✓ {name} ({span.seconds:.1f}s)", file=self.stream)
 
+    def record(self, name: str, seconds: float, *, run_id: str = "",
+               parent_id: str | None = None, end: float | None = None,
+               **meta) -> Span:
+        """Append an already-finished span of known duration — for work
+        measured outside a ``with phase(...)`` block (the continuous
+        scheduler times its segment on the device clock and records the
+        span after the fact, carrying links to the resident requests'
+        traces in ``meta``)."""
+        t_end = time.monotonic() if end is None else end
+        span = Span(
+            name=name, start=t_end - max(0.0, float(seconds)), end=t_end,
+            meta=dict(meta), span_id=events.new_id(),
+            parent_id=parent_id, run_id=run_id,
+        )
+        with self._lock:
+            self._spans.append(span)
+            self._total += 1
+        events.emit(
+            "span_end", span=span.span_id, parent=span.parent_id,
+            name=name, seconds=round(span.seconds, 6), **meta,
+        )
+        return span
+
     def mark(self) -> int:
         """Current span count — pass to :meth:`report` to scope one run's
         spans when several workflows share a process (tests, silent-install
