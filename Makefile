@@ -3,7 +3,7 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast analysis-check jax-check obs-check monitor-check flightrec-check alerts-check trace-check perf-check goodput-check serve-identity-check serve-continuous-check paged-check sharded-check resilience-check bench dryrun native dist dist-offline clean
+.PHONY: test test-fast analysis-check jax-check obs-check monitor-check flightrec-check alerts-check trace-check controller-check perf-check goodput-check serve-identity-check serve-continuous-check paged-check sharded-check resilience-check bench dryrun native dist dist-offline clean
 
 test:
 	python -m pytest tests/ -q
@@ -13,7 +13,7 @@ test:
 native:
 	python -c "from tpu_kubernetes import native; assert native.available(), 'native build failed'; print('native runtime OK')"
 
-test-fast: analysis-check jax-check trace-check
+test-fast: analysis-check jax-check trace-check controller-check
 	python -m pytest tests/ -q -m "not slow"
 
 # Invariant-analyzer gate: the AST contract passes (closed vocabularies,
@@ -43,7 +43,7 @@ jax-check: analysis-check
 # history store (tsdb), the fleet aggregator + SLO suite, plus a live
 # CPU server boot that scrapes GET /metrics and walks /debug/trace
 # (docs/guide/observability.md).
-obs-check: trace-check
+obs-check: trace-check controller-check
 	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py \
 	  tests/test_expfmt.py tests/test_tsdb.py tests/test_fleet_obs.py \
 	  tests/test_alerts.py tests/test_incidents.py \
@@ -100,6 +100,19 @@ flightrec-check:
 trace-check:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py \
 	  "tests/test_faults.py::test_trace_export_chaos_drops_spans_silently" \
+	  -q -m "not slow"
+
+# Fleet-controller gate: the closed-loop remediation suite — ledger /
+# action-vocabulary / router units, controller decision + guard units
+# (dry-run, cooldown, clamps, per-fingerprint dedup), the
+# fleet.remediate chaos matrix (failed actions in the incident bundle,
+# bounded retry backoff, no duplicate Terraform applies), the
+# two-live-server queue-runaway e2e (exactly one scale-up in exactly
+# one closed incident), the live drain scale-down with ledger
+# conservation, and the STATE column + `fleet control` / `get actions`
+# CLI surfaces (docs/guide/observability.md "Self-driving fleet").
+controller-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_controller.py \
 	  -q -m "not slow"
 
 # Perf gate: the CPU-deterministic microbench suites (obs/perfbench.py)

@@ -1,0 +1,18 @@
+"""Action-kind vocabulary with one undocumented entry and one call
+site minting a kind the vocabulary never registered."""
+
+ACTION_KINDS = frozenset({
+    "good_action",          # documented in the fixture guide — no finding
+    "undocumented_action",  # action-kind-undocumented
+})
+
+
+def new_action(kind: str, **fields):
+    if kind not in ACTION_KINDS:
+        raise ValueError(kind)
+    return {"kind": kind, **fields}
+
+
+def remediate():
+    new_action("good_action")     # registered — no finding
+    new_action("mystery_action")  # action-kind-unknown

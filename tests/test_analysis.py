@@ -27,6 +27,8 @@ ALL_CODES = {
     "metric-undocumented",
     "ledger-class-unknown",
     "alert-kind-unknown",
+    "action-kind-unknown",
+    "action-kind-undocumented",
     "env-undocumented",
     "env-stale-doc",
     "lock-unguarded-write",
@@ -73,6 +75,9 @@ def test_fixture_findings_carry_stable_symbols_and_locations():
     assert by_code["metric-undocumented"].symbol == "tpu_undocumented_total"
     assert by_code["ledger-class-unknown"].symbol == "mystery-class"
     assert by_code["alert-kind-unknown"].symbol == "mystery_kind"
+    assert by_code["action-kind-unknown"].symbol == "mystery_action"
+    assert by_code["action-kind-undocumented"].symbol == \
+        "undocumented_action"
     assert by_code["env-undocumented"].symbol == "SERVE_FIXTURE_UNDOC"
     assert by_code["env-stale-doc"].symbol == "SERVE_FIXTURE_STALE"
     assert by_code["lock-unguarded-write"].symbol == "Engine._count"

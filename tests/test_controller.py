@@ -1,0 +1,792 @@
+"""The closed loop: the observability-driven fleet controller
+(obs/controller.py + fleet/scaler.py) — action ledger and vocabulary
+units, saturation/prefix-affinity routing, the guard gauntlet (dry-run,
+cooldown, clamps, per-fingerprint dedup), the fleet.remediate chaos
+matrix, the two-live-server queue-runaway e2e (exactly one scale-up in
+exactly one closed incident bundle), the live drain scale-down with
+ledger conservation, the monitor STATE column, and the `fleet control`
+/ `get actions` CLI surfaces.
+
+The "workers" are live stdlib HTTP servers exposing a per-test Registry
+at /metrics (and optionally a /healthz lifecycle), as in
+test_fleet_obs.py — real sockets, no model bring-up except the one
+drain e2e that needs resident tokens to conserve.
+"""
+
+import http.client
+import http.server
+import io
+import json
+import threading
+import time
+
+import pytest
+
+from tpu_kubernetes.obs.aggregate import FleetAggregator
+from tpu_kubernetes.obs.alerts import AlertManager, QueueRunawayRule
+from tpu_kubernetes.obs.controller import (
+    ACTION_KINDS,
+    ACTIONS_TOTAL,
+    ActionLedger,
+    ENV_ACTIONS_FILE,
+    ENV_ACTIONS_KEEP,
+    ENV_COOLDOWN_S,
+    ENV_DRY_RUN,
+    ENV_MAX_ACTIONS,
+    ENV_MAX_REPLICAS,
+    ENV_MIN_REPLICAS,
+    FleetController,
+    FleetRouter,
+    fleet_goodput,
+    list_actions,
+    new_action,
+    render_actions,
+)
+from tpu_kubernetes.obs.faults import injected
+from tpu_kubernetes.obs.incidents import IncidentCorrelator, list_incidents
+from tpu_kubernetes.obs.metrics import Registry
+from tpu_kubernetes.obs.monitor import fleet_rows, render_table, run_monitor
+from tpu_kubernetes.fleet.scaler import FleetScaler, HTTPDrainer, default_render
+from tpu_kubernetes.shell.executor import FakeExecutor
+
+
+class _Exporter:
+    """A live /metrics endpoint over one Registry, optionally with a
+    /healthz lifecycle answer (code, payload)."""
+
+    def __init__(self, registry: Registry, healthz=None):
+        self.registry = registry
+        self.healthz = healthz
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):  # noqa: ARG002 — quiet tests
+                pass
+
+            def _send(self, code, body: bytes):
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/metrics":
+                    self._send(200, outer.registry.render().encode("utf-8"))
+                    return
+                if self.path == "/healthz" and outer.healthz is not None:
+                    code, payload = outer.healthz
+                    self._send(code, json.dumps(payload).encode("utf-8"))
+                    return
+                self._send(404, b"")
+
+        self.server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), Handler
+        )
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    @property
+    def target(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"{host}:{port}"
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _worker_registry(occupancy=0.0, inflight=0, bubble=0.0,
+                     emitted=0, useful=0, stalls=0) -> Registry:
+    """A registry shaped like one serve worker's, with the families the
+    router and controller read: occupancy/inflight feed the aggregator's
+    saturation gauge, plus bubble fraction, token ledger, page stalls."""
+    reg = Registry()
+    reg.counter("tpu_serve_requests_total", "requests",
+                labelnames=("endpoint", "code")).labels(
+        "/v1/completions", "200").inc(5)
+    reg.gauge("tpu_serve_slot_occupancy", "live rows").set(occupancy)
+    reg.gauge("tpu_serve_inflight_requests", "inflight").set(inflight)
+    reg.gauge("tpu_serve_slot_bubble_fraction", "bubble").set(bubble)
+    if stalls:
+        reg.counter("tpu_serve_kv_page_stalls_total", "stalls").inc(stalls)
+    if emitted:
+        reg.counter("tpu_serve_tokens_emitted_total", "emitted").inc(emitted)
+        tok = reg.counter("tpu_serve_tokens_total", "classes",
+                          labelnames=("class",))
+        tok.labels("useful").inc(useful)
+        if emitted > useful:
+            tok.labels("cancelled").inc(emitted - useful)
+    return reg
+
+
+class _Scaler:
+    """Duck-typed FleetScaler stand-in that just records."""
+
+    def __init__(self, replicas=1):
+        self.replicas = replicas
+        self.calls = []
+
+    def scale_to(self, n, targets=()):
+        self.calls.append(("scale_to", n))
+        self.replicas = n
+
+    def replace(self, instance):
+        self.calls.append(("replace", instance))
+
+
+class _Drainer:
+    def __init__(self, fail=False):
+        self.calls = []
+        self.fail = fail
+
+    def drain(self, instance):
+        self.calls.append(instance)
+        if self.fail:
+            raise RuntimeError("drain refused")
+        return {"status": "draining", "accepted": True}
+
+
+def _alert(fp="fp-1", kind="queue_runaway", rule="queue-runaway",
+           state="firing", instance="10.0.0.1:8000", **extra):
+    return dict({
+        "fingerprint": fp, "rule": rule, "kind": kind, "state": state,
+        "labels": {"instance": instance}, "severity": "page",
+        "summary": f"{kind} on {instance}", "value": 80.0,
+        "silenced": False,
+    }, **extra)
+
+
+def _controller(**kw):
+    """A live (non-dry-run) controller with hermetic actuators and no
+    ambient env, unless a test overrides."""
+    kw.setdefault("scaler", _Scaler(replicas=1))
+    kw.setdefault("drainer", _Drainer())
+    kw.setdefault("ledger", ActionLedger())
+    kw.setdefault("dry_run", False)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("env", {})
+    return FleetController(**kw)
+
+
+# -- the action vocabulary and ledger ----------------------------------------
+
+
+def test_new_action_enforces_the_closed_vocabulary():
+    a = new_action("scale_up", reason="test")
+    assert a["kind"] == "scale_up" and a["outcome"] == "proposed"
+    assert a["schema"] == "tpu-k8s-action/1"
+    # the audit fields always exist, even when empty
+    for field in ("alert_fingerprint", "trace_id", "incident_id",
+                  "target", "error", "signal"):
+        assert field in a
+    with pytest.raises(ValueError, match="unknown action kind"):
+        new_action("reboot_the_world")
+    with pytest.raises(ValueError, match="unknown action outcome"):
+        new_action("scale_up", outcome="maybe")
+    assert ACTION_KINDS == {"scale_up", "scale_down", "drain_replace"}
+
+
+def test_ledger_ring_bound_jsonl_sink_and_metric(tmp_path):
+    path = tmp_path / "actions.jsonl"
+    led = ActionLedger(path=path, keep=3)
+    before = ACTIONS_TOTAL.labels("scale_up", "proposed").value
+    for i in range(5):
+        led.record(new_action("scale_up", id=f"act-{i}"))
+    # the ring keeps the newest `keep`; the sink keeps everything
+    assert [a["id"] for a in led.actions()] == ["act-2", "act-3", "act-4"]
+    assert led.tail(2)[-1]["id"] == "act-4"
+    assert [a["id"] for a in list_actions(path)] == [
+        f"act-{i}" for i in range(5)
+    ]
+    assert ACTIONS_TOTAL.labels("scale_up", "proposed").value == before + 5
+
+
+def test_list_actions_tolerates_corrupt_tail_and_missing_file(tmp_path):
+    assert list_actions(tmp_path / "nope.jsonl") == []
+    path = tmp_path / "actions.jsonl"
+    path.write_text(
+        json.dumps(new_action("scale_down", id="ok")) + "\n"
+        + '{"half-written'  # the sink appends live
+    )
+    assert [a["id"] for a in list_actions(path)] == ["ok"]
+
+
+def test_render_actions_table_and_empty():
+    assert render_actions([]) == "no recorded actions\n"
+    text = render_actions([
+        new_action("scale_up", ts=1700000000.0, outcome="executed",
+                   rule="queue-runaway", alert_fingerprint="abcdef123456",
+                   target="10.0.0.1:8000", reason="queue depth 80"),
+        new_action("scale_up", outcome="failed", error="boom"),
+    ])
+    assert "KIND" in text and "FPRINT" in text
+    assert "executed" in text and "queue-runaway" in text
+    assert "[boom]" in text
+
+
+def test_ledger_and_controller_env_knobs(tmp_path):
+    led = ActionLedger.from_env({
+        ENV_ACTIONS_FILE: str(tmp_path / "a.jsonl"),
+        ENV_ACTIONS_KEEP: "7",
+    })
+    assert led.keep == 7 and led.path == tmp_path / "a.jsonl"
+    c = FleetController(scaler=_Scaler(), drainer=_Drainer(), env={
+        ENV_DRY_RUN: "0", ENV_COOLDOWN_S: "5.5", ENV_MAX_ACTIONS: "3",
+        ENV_MIN_REPLICAS: "2", ENV_MAX_REPLICAS: "4",
+        ENV_ACTIONS_FILE: "", ENV_ACTIONS_KEEP: "16",
+    })
+    assert c.dry_run is False
+    assert c.cooldown_s == 5.5 and c.max_actions == 3
+    assert c.min_replicas == 2 and c.max_replicas == 4
+    assert c.ledger.keep == 16 and c.ledger.path is None
+
+
+# -- the router ---------------------------------------------------------------
+
+
+def test_router_prefers_least_saturated_and_sticks_to_prefix():
+    idle = _Exporter(_worker_registry(occupancy=0.0, inflight=0))
+    busy = _Exporter(_worker_registry(occupancy=3.0, inflight=6))
+    try:
+        agg = FleetAggregator([idle.target, busy.target])
+        router = FleetRouter()
+        router.update(agg.scrape_once(now=1000.0))
+        assert sorted(router.eligible()) == sorted(
+            [idle.target, busy.target])
+        # fresh prompt → the idle instance, and the prefix pins there
+        prompt = "tell me about TPU pods " * 8
+        assert router.route(prompt) == idle.target
+        # the pinned instance gets moderately busy, the sibling frees
+        # up — stickiness holds below the ceiling (warm prefix wins)
+        idle.registry = _worker_registry(occupancy=2.0, inflight=4)
+        busy.registry = _worker_registry(occupancy=0.0, inflight=0)
+        router.update(agg.scrape_once(now=1010.0))
+        assert router.route(prompt) == idle.target      # sticky
+        assert router.route("unrelated") == busy.target  # fresh → least
+        # saturated past the ceiling: stickiness yields and re-pins
+        idle.registry = _worker_registry(occupancy=50.0, inflight=50)
+        router.update(agg.scrape_once(now=1020.0))
+        assert router.route(prompt) == busy.target
+    finally:
+        idle.stop()
+        busy.stop()
+
+
+def test_router_page_stall_pressure_breaks_saturation_ties():
+    a = _Exporter(_worker_registry(stalls=0))
+    b = _Exporter(_worker_registry(stalls=0))
+    try:
+        agg = FleetAggregator([a.target, b.target])
+        router = FleetRouter()
+        router.update(agg.scrape_once(now=1.0))  # seeds stall baselines
+        # b develops page pressure between cycles; saturation stays 0
+        b.registry = _worker_registry(stalls=40)
+        router.update(agg.scrape_once(now=2.0))
+        assert router.route("p") == a.target
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_router_skips_draining_and_down_instances():
+    a = _Exporter(_worker_registry(), healthz=(200, {"status": "ok"}))
+    b = _Exporter(_worker_registry(),
+                  healthz=(503, {"status": "draining"}))
+    try:
+        agg = FleetAggregator([a.target, b.target], probe_health=True)
+        router = FleetRouter()
+        router.update(agg.scrape_once(now=1.0))
+        assert router.eligible() == [a.target]
+        assert router.route("p") == a.target
+        a.stop()  # now the only eligible instance dies
+        router.update(agg.scrape_once(now=2.0))
+        assert router.route("p") is None
+    finally:
+        b.stop()
+
+
+def test_fleet_goodput_reads_the_token_ledger():
+    w = _Exporter(_worker_registry(emitted=200, useful=150))
+    empty = _Exporter(_worker_registry())
+    try:
+        snap = FleetAggregator([w.target]).scrape_once(now=1.0)
+        assert fleet_goodput(snap) == pytest.approx(0.75)
+        assert fleet_goodput(
+            FleetAggregator([empty.target]).scrape_once(now=1.0)) is None
+        assert fleet_goodput(None) is None
+    finally:
+        w.stop()
+        empty.stop()
+
+
+# -- controller decisions and guards -----------------------------------------
+
+
+def test_dry_run_records_suppressed_without_touching_the_executor():
+    fake = FakeExecutor()
+    c = _controller(scaler=None, executor=fake, dry_run=True)
+    records = c.observe([_alert()], now=1000.0)
+    assert [r["outcome"] for r in records] == ["proposed", "suppressed"]
+    assert records[0]["kind"] == "scale_up"
+    assert records[1]["reason"].startswith("dry-run")
+    assert records[0]["alert_fingerprint"] == "fp-1"
+    assert len(records[0]["trace_id"]) == 32      # auditable end to end
+    assert fake.calls == []                        # never actuated
+    # the suppression is terminal for the episode: no ledger spam
+    assert c.observe([_alert()], now=1005.0) == []
+
+
+def test_live_scale_up_applies_terraform_exactly_once_per_fingerprint():
+    fake = FakeExecutor()
+    c = _controller(scaler=None, executor=fake)
+    records = c.observe([_alert()], now=1000.0)
+    assert [r["outcome"] for r in records] == ["proposed", "executed"]
+    assert records[1]["signal"]["replicas"] == 2
+    assert c.replicas() == 2
+    (call,) = fake.calls
+    assert call.command == "apply"
+    assert call.document["module"]["fleet"]["replicas"] == 2
+    # same firing alert next cycles: no duplicate Terraform invocation
+    assert c.observe([_alert()], now=1010.0) == []
+    assert len(fake.calls) == 1
+    # the episode resolves, then re-fires: that IS a new decision
+    assert c.observe([_alert(state="resolved")], now=1020.0) == []
+    again = c.observe([_alert()], now=1030.0)
+    assert [r["outcome"] for r in again] == ["proposed", "executed"]
+    assert len(fake.calls) == 2
+
+
+def test_slo_burn_maps_to_scale_up_and_cooldown_suppresses():
+    c = _controller(cooldown_s=300.0)
+    first = c.observe(
+        [_alert(fp="fp-a", kind="slo_burn", rule="slo-availability")],
+        now=1000.0)
+    assert [r["outcome"] for r in first] == ["proposed", "executed"]
+    assert first[1]["kind"] == "scale_up"
+    # a different fingerprint, same kind, inside the hold-down
+    second = c.observe([_alert(fp="fp-b")], now=1030.0)
+    assert [r["outcome"] for r in second] == ["proposed", "suppressed"]
+    assert "cooldown" in second[1]["reason"]
+    assert c.scaler.calls == [("scale_to", 2)]     # one actuation only
+    # past the hold-down a third fingerprint actuates again
+    third = c.observe([_alert(fp="fp-c")], now=1400.0)
+    assert third[-1]["outcome"] == "executed"
+
+
+def test_max_actions_per_cycle_caps_the_blast_radius():
+    c = _controller(max_actions=1)
+    records = c.observe(
+        [_alert(fp="fp-a", instance="i-a"),
+         _alert(fp="fp-b", instance="i-b")], now=1000.0)
+    executed = [r for r in records if r["outcome"] == "executed"]
+    assert len(executed) == 1                      # one actuation this cycle
+    # the deferred fingerprint acts on the NEXT cycle
+    later = c.observe(
+        [_alert(fp="fp-a", instance="i-a"),
+         _alert(fp="fp-b", instance="i-b")], now=1010.0)
+    assert [r["outcome"] for r in later] == ["proposed", "executed"]
+    assert {r["alert_fingerprint"] for r in records + later} == \
+        {"fp-a", "fp-b"}
+
+
+def test_replica_clamps_suppress_instead_of_acting():
+    c = _controller(scaler=_Scaler(replicas=4), max_replicas=4)
+    records = c.observe([_alert()], now=1000.0)
+    assert records[-1]["outcome"] == "suppressed"
+    assert "at max replicas" in records[-1]["reason"]
+    assert c.scaler.calls == []
+
+
+def test_engine_restart_loop_drains_and_replaces():
+    drainer = _Drainer(fail=True)  # a sick instance may not answer
+    c = _controller(scaler=_Scaler(replicas=2), drainer=drainer)
+    records = c.observe(
+        [_alert(kind="engine_restart", rule="engine-restarts",
+                instance="10.0.0.9:8000")], now=1000.0)
+    assert [r["outcome"] for r in records] == ["proposed", "executed"]
+    assert records[1]["kind"] == "drain_replace"
+    # best-effort drain: the failure is recorded, replacement proceeded
+    assert "drain" in records[1]["signal"]
+    assert "error" in records[1]["signal"]["drain"]
+    assert c.scaler.calls == [("replace", "10.0.0.9:8000")]
+
+
+def test_idle_fleet_scales_down_via_drain_with_goodput_veto():
+    idle = _Exporter(_worker_registry(emitted=100, useful=100))
+    wasteful = _Exporter(_worker_registry(emitted=100, useful=40))
+    try:
+        snap_ok = FleetAggregator([idle.target]).scrape_once(now=1.0)
+        snap_bad = FleetAggregator([wasteful.target]).scrape_once(now=1.0)
+        # degraded goodput vetoes the shrink even though the fleet idles
+        c = _controller(scaler=_Scaler(replicas=2), idle_hold_s=0.0)
+        assert c.observe([], now=1000.0, snapshot=snap_bad) == []
+        # healthy goodput: drain first, then shrink — zero token loss
+        c2 = _controller(scaler=_Scaler(replicas=2), idle_hold_s=0.0)
+        records = c2.observe([], now=1000.0, snapshot=snap_ok)
+        assert [r["outcome"] for r in records] == ["proposed", "executed"]
+        assert records[1]["kind"] == "scale_down"
+        assert records[1]["alert_fingerprint"] == f"idle:{idle.target}"
+        assert c2.drainer.calls == [idle.target]
+        assert c2.scaler.calls == [("scale_to", 1)]
+        assert records[1]["signal"]["drain"]["accepted"] is True
+        # at min replicas now: a further idle cycle has nothing to shrink
+        assert c2.observe([], now=2000.0, snapshot=snap_ok) == []
+    finally:
+        idle.stop()
+        wasteful.stop()
+
+
+def test_idle_hold_requires_sustained_idleness_and_firing_resets_it():
+    idle = _Exporter(_worker_registry(emitted=10, useful=10))
+    try:
+        agg = FleetAggregator([idle.target])
+        snap = agg.scrape_once(now=1.0)
+        c = _controller(scaler=_Scaler(replicas=2), idle_hold_s=60.0)
+        assert c.observe([], now=1000.0, snapshot=snap) == []   # arming
+        assert c.observe([], now=1030.0, snapshot=snap) == []   # holding
+        # a firing alert interrupts the idle streak entirely
+        c.observe([_alert()], now=1040.0, snapshot=snap)
+        assert c.observe([], now=1070.0, snapshot=snap) == []   # re-arming
+        records = c.observe([], now=1140.0, snapshot=snap)      # sustained
+        assert records and records[-1]["kind"] == "scale_down"
+    finally:
+        idle.stop()
+
+
+# -- chaos: the fleet.remediate site -----------------------------------------
+
+
+def test_chaos_remediate_fails_into_the_incident_bundle_with_backoff(
+        tmp_path):
+    """fleet.remediate at prob 1.0: the action fails loudly into the
+    triggering incident bundle, retries are bounded with exponential
+    backoff, and the Terraform path is never invoked — per fingerprint,
+    zero duplicate applies."""
+    fake = FakeExecutor()
+    incidents = IncidentCorrelator(directory=str(tmp_path), store=None)
+    c = _controller(scaler=None, executor=fake, incidents=incidents,
+                    max_retries=1, retry_backoff_s=10.0)
+    alert = _alert()
+    incidents.observe([alert], now=1000.0)         # detect: incident opens
+    incident_id = incidents.current_incident_id()
+    assert incident_id
+
+    with injected("fleet.remediate:1.0"):
+        first = c.observe([alert], now=1000.0)
+        assert [r["outcome"] for r in first] == ["proposed", "failed"]
+        assert "injected" in first[1]["error"]
+        assert first[1]["incident_id"] == incident_id
+        # inside the backoff window: nothing new, no hammering
+        assert c.observe([alert], now=1005.0) == []
+        # past it: one bounded retry, then the episode is exhausted
+        second = c.observe([alert], now=1011.0)
+        assert [r["outcome"] for r in second] == ["failed"]
+        assert "retries exhausted" in second[0]["error"]
+        assert c.observe([alert], now=1100.0) == []
+
+    # chaos heals, but the fingerprint was exhausted — still no retry,
+    # and the executor was NEVER reached (the fault fires first)
+    assert c.observe([alert], now=1200.0) == []
+    assert fake.calls == []
+
+    (bundle,) = list_incidents(str(tmp_path))
+    outcomes = [a["outcome"] for a in bundle["actions"]]
+    assert outcomes == ["proposed", "failed", "failed"]
+    assert all(a["alert_fingerprint"] == "fp-1"
+               for a in bundle["actions"])
+
+
+def test_observe_never_raises_even_with_broken_actuators():
+    class _Exploding:
+        replicas = 1
+
+        def scale_to(self, n, targets=()):
+            raise RuntimeError("boom")
+
+        def replace(self, instance):
+            raise RuntimeError("boom")
+
+    c = _controller(scaler=_Exploding(), max_retries=0)
+    records = c.observe([_alert()], now=1000.0)
+    assert records[-1]["outcome"] == "failed"
+    assert "retries exhausted" in records[-1]["error"]
+
+
+# -- the two-live-server closed-loop e2e -------------------------------------
+
+
+def test_queue_runaway_end_to_end_one_scale_up_one_closed_incident(
+        tmp_path):
+    """The acceptance path: two live workers, an injected queue
+    runaway, the full detect → decide → actuate → resolve loop on CPU —
+    exactly one scale-up action in exactly one closed incident bundle,
+    and exactly one FakeExecutor apply."""
+    calm = _Exporter(_worker_registry(inflight=2))
+    flooded = _Exporter(_worker_registry(inflight=80))
+    fake = FakeExecutor()
+    incidents = IncidentCorrelator(
+        directory=str(tmp_path), close_after_s=30.0, store=None)
+    manager = AlertManager([QueueRunawayRule(max_depth=64.0)],
+                           incidents=incidents)
+    ledger = ActionLedger(path=tmp_path / "actions.jsonl")
+    controller = FleetController(
+        executor=fake, incidents=incidents, ledger=ledger,
+        dry_run=False, cooldown_s=0.0, env={},
+    )
+    manager.listeners.append(controller)
+    agg = FleetAggregator([calm.target, flooded.target],
+                          alerts=manager, probe_health=True)
+    try:
+        agg.scrape_once(now=1000.0)    # detect: breach starts pending
+        assert fake.calls == []        # the for_s hold, not a twitch
+        agg.scrape_once(now=1031.0)    # fires → incident → decide+actuate
+        assert len(fake.calls) == 1
+        assert fake.calls[0].command == "apply"
+        assert controller.replicas() == 2
+
+        # the runaway drains; further cycles resolve and close
+        flooded.registry = _worker_registry(inflight=0)
+        for t in (1040.0, 1100.0, 1200.0, 1300.0):
+            agg.scrape_once(now=t)
+            if list_incidents(str(tmp_path)) and \
+                    list_incidents(str(tmp_path))[0]["status"] == "closed":
+                break
+
+        (bundle,) = list_incidents(str(tmp_path))   # exactly one bundle
+        assert bundle["status"] == "closed"
+        (fp,) = list(bundle["alerts"])
+        member = bundle["alerts"][fp]
+        assert member["kind"] == "queue_runaway"
+        assert member["labels"]["instance"] == flooded.target
+
+        # the audit trail reads as one story: proposed then executed,
+        # stamped with the same fingerprint, trace id, and incident id
+        actions = bundle["actions"]
+        assert [a["outcome"] for a in actions] == ["proposed", "executed"]
+        executed = actions[1]
+        assert executed["kind"] == "scale_up"
+        assert executed["alert_fingerprint"] == fp
+        assert executed["incident_id"] == bundle["incident_id"]
+        assert len(executed["trace_id"]) == 32
+        assert executed["target"] == flooded.target
+        # goodput (not RPS) rode along as the scaling signal
+        assert "goodput" in executed["signal"]
+        # the same records landed in the standalone JSONL ledger
+        assert [a["outcome"] for a in list_actions(ledger.path)] == \
+            ["proposed", "executed"]
+        # exactly one actuation total, cycle after cycle
+        assert len(fake.calls) == 1
+    finally:
+        manager.close()
+        calm.stop()
+        flooded.stop()
+
+
+# -- live drain scale-down (real server, real /drain) ------------------------
+
+
+def test_scale_down_drains_live_server_without_losing_resident_tokens():
+    """The controller's POST /drain path against a real serving worker:
+    the resident request finishes cleanly (zero token loss), the server
+    quiesces, and the token ledger still conserves."""
+    from tpu_kubernetes.obs.ledger import LEDGER
+    from tpu_kubernetes.serve.server import make_server
+
+    srv = make_server({
+        "SERVE_MODEL": "llama-test", "SERVE_MAX_NEW": "16",
+        "SERVE_DTYPE": "float32", "SERVER_HOST": "127.0.0.1",
+        "SERVER_PORT": "0", "SERVE_CONTINUOUS_BATCHING": "1",
+        "SERVER_BATCH": "2",
+    })
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    st = srv.RequestHandlerClass.state
+    host, port = srv.server_address[:2]
+    target = f"{host}:{port}"
+    results = []
+
+    def inflight():
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("POST", "/v1/completions", body=json.dumps({
+            "prompt": "the quick brown fox jumps over the lazy dog",
+            "max_new_tokens": 12,
+        }), headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        results.append((resp.status, resp.read()))
+        conn.close()
+
+    try:
+        t = threading.Thread(target=inflight)
+        t.start()
+        deadline = time.monotonic() + 30
+        while (st._engine.stats()["occupied"] == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+
+        snap = FleetAggregator([target], probe_health=True,
+                               timeout_s=10.0).scrape_once(now=1000.0)
+        assert snap.health[target].up == 1
+        # permissive idle thresholds: this test exercises the actuation
+        # path (the detector's thresholds have their own units above)
+        c = _controller(
+            scaler=_Scaler(replicas=2), drainer=HTTPDrainer(),
+            idle_hold_s=0.0, idle_saturation=10.0, bubble_ceiling=10.0,
+            goodput_floor=0.0,
+        )
+        records = c.observe([], now=1000.0, snapshot=snap)
+        assert [r["outcome"] for r in records] == ["proposed", "executed"]
+        assert records[1]["kind"] == "scale_down"
+        assert records[1]["signal"]["drain"]["accepted"] is True
+        assert c.scaler.calls == [("scale_to", 1)]
+
+        # the resident request finished cleanly — zero token loss
+        t.join(60)
+        assert not t.is_alive()
+        status, body = results[0]
+        assert status == 200 and json.loads(body)["text"]
+
+        assert st.drain.wait_drained(timeout=30)
+        thread.join(30)
+        assert not thread.is_alive()              # serve_forever returned
+
+        # ledger conservation at quiescence: classes settle to emitted
+        snap_ledger = LEDGER.snapshot()
+        assert snap_ledger["unsettled"] == 0
+        assert sum(snap_ledger["classes"].values()) == \
+            snap_ledger["emitted"]
+    finally:
+        if thread.is_alive():
+            srv.shutdown()
+
+
+# -- the monitor STATE column ------------------------------------------------
+
+
+def test_monitor_state_column_from_healthz():
+    serving = _Exporter(_worker_registry(), healthz=(200, {"status": "ok"}))
+    draining = _Exporter(_worker_registry(),
+                         healthz=(503, {"status": "draining"}))
+    bare = _Exporter(_worker_registry())          # no healthz at all
+    try:
+        agg = FleetAggregator(
+            [serving.target, draining.target, bare.target],
+            probe_health=True)
+        snap = agg.scrape_once(now=1.0)
+        assert snap.health[serving.target].lifecycle == "serving"
+        assert snap.health[draining.target].lifecycle == "draining"
+        assert snap.health[bare.target].lifecycle == ""
+        rows = {r["instance"]: r for r in fleet_rows(snap)}
+        assert rows[serving.target]["state"] == "serving"
+        assert rows[draining.target]["state"] == "draining"
+        assert rows[bare.target]["state"] is None
+        table = render_table(fleet_rows(snap), [])
+        assert "STATE" in table
+        assert "serving" in table and "draining" in table
+    finally:
+        serving.stop()
+        draining.stop()
+        bare.stop()
+
+
+def test_monitor_json_carries_instance_state():
+    w = _Exporter(_worker_registry(), healthz=(200, {"status": "ok"}))
+    try:
+        buf = io.StringIO()
+        assert run_monitor([w.target], once=True, as_json=True,
+                           out=buf) == 0
+        snap = json.loads(buf.getvalue().strip().splitlines()[-1])
+        assert snap["instances"][w.target]["state"] == "serving"
+    finally:
+        w.stop()
+
+
+def test_failed_healthz_state_reaches_the_monitor():
+    w = _Exporter(_worker_registry(),
+                  healthz=(503, {"status": "failed", "reason": "watchdog"}))
+    try:
+        snap = FleetAggregator([w.target],
+                               probe_health=True).scrape_once(now=1.0)
+        (row,) = fleet_rows(snap)
+        assert row["state"] == "failed"
+        # and the router refuses to place work there
+        router = FleetRouter()
+        router.update(snap)
+        assert router.route("p") is None
+    finally:
+        w.stop()
+
+
+# -- the fleet actuators ------------------------------------------------------
+
+
+def test_fleet_scaler_renders_replica_documents_and_targets_modules():
+    fake = FakeExecutor()
+    scaler = FleetScaler(fake, replicas=1)
+    scaler.scale_to(3)
+    assert scaler.replicas == 3
+    scaler.replace("10.0.0.5:8000")
+    first, second = fake.calls
+    assert first.document == default_render(3).to_dict()
+    assert first.targets == ()
+    assert second.targets == ("module.10-0-0-5-8000",)
+
+
+# -- CLI surfaces -------------------------------------------------------------
+
+
+def test_cli_get_actions_table_json_and_env_default(
+        tmp_path, capsys, monkeypatch):
+    from tpu_kubernetes.cli.main import main
+
+    path = tmp_path / "actions.jsonl"
+    led = ActionLedger(path=path)
+    led.record(new_action("scale_up", ts=1700000000.0, outcome="executed",
+                          rule="queue-runaway", target="10.0.0.1:8000"))
+    led.record(new_action("scale_down", outcome="suppressed",
+                          reason="dry-run"))
+
+    assert main(["get", "actions", "--file", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "scale_up" in out and "suppressed" in out
+
+    assert main(["get", "actions", "--file", str(path), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert [a["kind"] for a in payload] == ["scale_up", "scale_down"]
+
+    # TPU_K8S_ACTIONS_FILE is the --file default
+    monkeypatch.setenv(ENV_ACTIONS_FILE, str(path))
+    assert main(["get", "actions", "--json"]) == 0
+    assert len(json.loads(capsys.readouterr().out)) == 2
+
+    assert main(["get", "actions",
+                 "--file", str(tmp_path / "none.jsonl")]) == 0
+    assert "no recorded actions" in capsys.readouterr().out
+
+
+def test_cli_fleet_control_once_json_dry_run(tmp_path, capsys, monkeypatch):
+    from tpu_kubernetes.cli.main import main
+
+    monkeypatch.setenv("TPU_K8S_INCIDENTS_DIR", str(tmp_path))
+    monkeypatch.delenv(ENV_DRY_RUN, raising=False)
+    w = _Exporter(_worker_registry(inflight=2),
+                  healthz=(200, {"status": "ok"}))
+    try:
+        assert main(["fleet", "control", "--once", "--json",
+                     "--targets", w.target]) == 0
+        snap = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert snap["dry_run"] is True            # safe by default
+        assert snap["instances"][w.target] == {
+            "up": 1, "state": "serving"}
+        assert snap["actions"] == []              # nothing fired in one cycle
+        assert snap["replicas"] >= 1
+    finally:
+        w.stop()
+
+
+def test_cli_fleet_control_needs_a_target(capsys):
+    from tpu_kubernetes.cli.main import main
+
+    assert main(["fleet", "control", "--targets", " "]) == 2
+    assert "at least one" in capsys.readouterr().err

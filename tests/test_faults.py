@@ -98,8 +98,8 @@ def test_site_vocabulary_is_closed():
     assert set(SITES) == {
         "serve.prefill", "serve.slot_insert", "serve.segment",
         "serve.shard_segment", "serve.prefix_insert", "serve.page_alloc",
-        "fleet.scrape", "shell.terraform", "obs.alert_sink",
-        "obs.trace_export",
+        "fleet.scrape", "fleet.remediate", "shell.terraform",
+        "obs.alert_sink", "obs.trace_export",
     }
     assert ENV_VAR == "TPU_K8S_FAULTS"
 

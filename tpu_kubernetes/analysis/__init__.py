@@ -69,6 +69,12 @@ FINDING_CODES = {
         "vocabulary",
     "alert-kind-unknown":
         "alerts.d rule kind not registered via @rule_kind",
+    "action-kind-unknown":
+        "new_action() literal not in the obs/controller.py ACTION_KINDS "
+        "vocabulary",
+    "action-kind-undocumented":
+        "ACTION_KINDS entry missing from the docs/guide/observability.md "
+        "action table (an undocumented remediation is an unauditable one)",
     "env-undocumented":
         "TPU_K8S_*/SERVE_*/SERVER_* env read with no docs-table or "
         "module-docstring row",

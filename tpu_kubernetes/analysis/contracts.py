@@ -22,12 +22,18 @@ pass closes the other end:
 * alerts.d ``"kind"`` values vs the ``@rule_kind`` registry
   (`alert-kind-unknown`). build_rule() rejects unknown kinds at load
   time; this catches them before a rule file ships.
+* ``new_action("<kind>")`` literals vs the ``ACTION_KINDS`` frozenset
+  in obs/controller.py (`action-kind-unknown`), and every registered
+  kind must appear in the observability guide's action table
+  (`action-kind-undocumented`) — a fleet remediation the docs don't
+  name is an unauditable one.
 """
 
 from __future__ import annotations
 
 import ast
 import json
+import re
 from pathlib import Path
 
 from tpu_kubernetes.analysis import (
@@ -49,6 +55,7 @@ def run(project: Project) -> list[Finding]:
     out.extend(_check_metrics(project))
     out.extend(_check_ledger_classes(project))
     out.extend(_check_alert_kinds(project))
+    out.extend(_check_action_kinds(project))
     return out
 
 
@@ -384,5 +391,51 @@ def _check_alert_kinds(project: Project) -> list[Finding]:
                     f"rule kind {kind!r} is not registered via "
                     "@rule_kind (build_rule would reject this file at "
                     "load time)",
+                ))
+    return out
+
+
+# -- controller action kinds -----------------------------------------------
+
+def _check_action_kinds(project: Project) -> list[Finding]:
+    """The fleet controller's closed remediation vocabulary, checked
+    both ways like fault sites: every ``new_action("<kind>")`` literal
+    must be in the ``ACTION_KINDS`` frozenset (runtime new_action()
+    raises, but only when the branch runs), and every registered kind
+    must be named in the observability guide — the action table IS the
+    operator's contract for what a self-driving fleet may do."""
+    kinds_path, kinds_line, kinds = _module_str_set(
+        project, "ACTION_KINDS", "controller.py"
+    )
+    if kinds_path is None:
+        return []  # not a controller-bearing tree
+    out: list[Finding] = []
+    for path in project.py_files():
+        for node in ast.walk(project.parse(path)):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if not (name == "new_action" or name.endswith(".new_action")):
+                continue
+            if not node.args:
+                continue
+            kind = str_const(node.args[0])
+            if kind is not None and kind not in kinds:
+                out.append(Finding(
+                    "action-kind-unknown", project.rel(path),
+                    node.lineno, kind,
+                    f"new_action({kind!r}) is not in the ACTION_KINDS "
+                    f"vocabulary ({project.rel(kinds_path)})",
+                ))
+    if project.metric_doc is not None:
+        doc_text = project.metric_doc.read_text(encoding="utf-8")
+        for kind in sorted(kinds):
+            if not re.search(rf"\b{re.escape(kind)}\b", doc_text):
+                out.append(Finding(
+                    "action-kind-undocumented", project.rel(kinds_path),
+                    kinds_line, kind,
+                    f"action kind {kind!r} is registered but missing "
+                    f"from the {project.rel(project.metric_doc)} "
+                    "action table",
                 ))
     return out

@@ -84,7 +84,7 @@ def build_parser() -> argparse.ArgumentParser:
         "kind",
         choices=["manager", "cluster", "kubeconfig", "runs", "metrics",
                  "profile", "goodput", "history", "flightrec", "alerts",
-                 "incidents", "trace"],
+                 "incidents", "trace", "actions"],
         help="profile renders the worker's phase table — cold (prefill) "
              "vs warm (prefill_warm) prefills split out, so prefix-cache "
              "savings are read off one row pair; goodput renders the "
@@ -97,7 +97,9 @@ def build_parser() -> argparse.ArgumentParser:
              "alerts and silences (GET /debug/alerts); incidents lists "
              "local incident bundles (see --dir); trace stitches one "
              "distributed trace's spans from every --targets instance "
-             "into a cross-instance tree with a critical-path breakdown",
+             "into a cross-instance tree with a critical-path breakdown; "
+             "actions lists the fleet controller's action ledger "
+             "(see --file)",
     )
     get.add_argument(
         "metric", nargs="?", metavar="METRIC",
@@ -130,6 +132,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--targets", metavar="HOST:PORT[,HOST:PORT...]", default=None,
         help="with history/trace: comma-separated worker endpoints to "
              "query (default: the --target value)",
+    )
+    get.add_argument(
+        "--file", dest="actions_file", metavar="FILE", default=None,
+        help="with actions: the JSONL ledger sink to list (default "
+             "runs/actions.jsonl, or TPU_K8S_ACTIONS_FILE)",
     )
     get.add_argument(
         "--window", type=float, default=60.0, metavar="SECONDS",
@@ -199,6 +206,44 @@ def build_parser() -> argparse.ArgumentParser:
         "--window", type=float, default=60.0, metavar="SECONDS",
         help="trailing window the rate and sparkline trend columns "
              "cover (default 60)",
+    )
+
+    fleet = sub.add_parser(
+        "fleet",
+        help="close the observability loop: scrape the fleet, evaluate "
+             "the standard alert rules, and let the controller "
+             "remediate (scale up/down, drain-and-replace) — dry-run "
+             "by default, every action ledgered (obs/controller.py)",
+    )
+    fleet.add_argument("action", choices=["control"])
+    fleet.add_argument(
+        "--targets", metavar="HOST:PORT[,HOST:PORT...]",
+        default="127.0.0.1:8000",
+        help="comma-separated worker endpoints (default 127.0.0.1:8000)",
+    )
+    fleet.add_argument(
+        "--interval", type=float, default=5.0, metavar="SECONDS",
+        help="seconds between control cycles (default 5)",
+    )
+    fleet.add_argument(
+        "--once", action="store_true",
+        help="one control cycle, then exit (scripting/smoke checks)",
+    )
+    fleet.add_argument(
+        "--max-cycles", type=int, default=None, metavar="N",
+        help="stop after N control cycles (default: run until ^C)",
+    )
+    fleet.add_argument(
+        "--json", dest="as_json", action="store_true",
+        help="emit one JSON snapshot per cycle (replicas, per-instance "
+             "state, active alerts, actions) instead of the status line",
+    )
+    fleet.add_argument(
+        "--apply", action="store_true",
+        help="actually actuate: scale via the Terraform executor and "
+             "drain via POST /drain; without this every decision is "
+             "recorded as suppressed (equivalent to "
+             "TPU_K8S_CONTROLLER_DRY_RUN=0)",
     )
 
     bench = sub.add_parser(
@@ -418,6 +463,43 @@ def main(argv: list[str] | None = None) -> int:
             print(json.dumps(data, indent=2, sort_keys=True))
         else:
             print(render_alerts(data), end="")
+        return 0
+
+    if args.command == "fleet":
+        # the self-driving loop needs the worker endpoints, not a
+        # backend/config — same stance as monitor (obs/controller.py)
+        from tpu_kubernetes.obs.controller import run_controller
+
+        targets = [t.strip() for t in args.targets.split(",") if t.strip()]
+        if not targets:
+            print("error: fleet control needs at least one --targets "
+                  "endpoint", file=sys.stderr)
+            return 2
+        return run_controller(
+            targets, interval=args.interval, once=args.once,
+            as_json=args.as_json, max_cycles=args.max_cycles,
+            dry_run=False if args.apply else None,
+        )
+
+    if args.command == "get" and args.kind == "actions":
+        # the controller's JSONL action ledger, rendered — offline
+        # audits need no live worker (obs/controller.py)
+        import os as _os
+
+        from tpu_kubernetes.obs.controller import (
+            ENV_ACTIONS_FILE as _ACTIONS_ENV,
+            list_actions,
+            render_actions,
+        )
+
+        path = (args.actions_file
+                or _os.environ.get(_ACTIONS_ENV, "")
+                or "runs/actions.jsonl")
+        actions = list_actions(path)
+        if args.as_json:
+            print(json.dumps(actions, indent=2, sort_keys=True))
+        else:
+            print(render_actions(actions), end="")
         return 0
 
     if args.command == "get" and args.kind == "incidents":

@@ -1,6 +1,9 @@
 """Fleet control-plane client: the framework's window into the manager's
 kube API (node lifecycle on destroy/repair, health for preemption
-detection, registration records). See fleet/api.py and fleet/nodes.py."""
+detection, registration records), plus the actuators the
+observability-driven controller (obs/controller.py) drives — a
+Terraform-path scaler and an HTTP drainer. See fleet/api.py,
+fleet/nodes.py, and fleet/scaler.py."""
 
 from tpu_kubernetes.fleet.api import FleetAPI, FleetAPIError  # noqa: F401
 from tpu_kubernetes.fleet.nodes import (  # noqa: F401
@@ -9,4 +12,9 @@ from tpu_kubernetes.fleet.nodes import (  # noqa: F401
     node_names_for_host,
     node_ready,
     resolve_fleet_api,
+)
+from tpu_kubernetes.fleet.scaler import (  # noqa: F401
+    FleetScaler,
+    HTTPDrainer,
+    default_render,
 )

@@ -1,0 +1,97 @@
+"""Fleet actuators: the two hands of the observability-driven controller.
+
+The FleetController (obs/controller.py) decides *what* to do from the
+alert stream; this module is *how* it touches the world, kept thin and
+separately testable:
+
+* :class:`FleetScaler` — renders a replica-count Terraform-JSON state
+  document (state/document.py) and applies it through an
+  :class:`~tpu_kubernetes.shell.executor.Executor`. With a
+  :class:`~tpu_kubernetes.shell.executor.FakeExecutor` the whole
+  scale-up path runs end-to-end on CPU, and every apply is a recorded
+  call the tests (and the action ledger) can count.
+* :class:`HTTPDrainer` — ``POST /drain`` against a serving instance
+  (the Kubernetes preStop contract in serve/server.py): admission stops,
+  resident work finishes, and only then may Terraform reap the node —
+  scale-down never drops resident tokens.
+
+Both carry W3C trace context on outbound calls where applicable, so an
+actuation shows up in the same distributed trace as the alert that
+caused it.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Callable
+
+from tpu_kubernetes.obs import tracing
+from tpu_kubernetes.shell.executor import Executor
+from tpu_kubernetes.state.document import State
+
+
+def default_render(replicas: int) -> State:
+    """The minimal replica-count document: one ``fleet`` module whose
+    only knob is ``replicas``. Real deployments pass their own render
+    callable that folds the count into the full cluster document."""
+    return State("fleet", {"module": {"fleet": {"replicas": int(replicas)}}})
+
+
+def _module_key(instance: str) -> str:
+    """A ``host:port`` instance label as a Terraform module address
+    component (dots and colons are not address characters)."""
+    return instance.replace(":", "-").replace(".", "-")
+
+
+class FleetScaler:
+    """Terraform-path actuator: ``scale_to(n)`` renders the document for
+    ``n`` replicas and applies it; ``replace(instance)`` re-applies the
+    current count targeted at one worker's module (Terraform recreates
+    just that node). ``replicas`` tracks the last applied count — the
+    controller's notion of current fleet size."""
+
+    def __init__(self, executor: Executor,
+                 render: Callable[[int], State] | None = None,
+                 replicas: int = 1):
+        self.executor = executor
+        self.render = render or default_render
+        self.replicas = int(replicas)
+
+    def scale_to(self, replicas: int, targets: tuple[str, ...] = ()) -> None:
+        n = int(replicas)
+        self.executor.apply(self.render(n), targets=tuple(targets))
+        self.replicas = n
+
+    def replace(self, instance: str) -> None:
+        self.executor.apply(
+            self.render(self.replicas),
+            targets=(f"module.{_module_key(instance)}",),
+        )
+
+
+class HTTPDrainer:
+    """``POST /drain`` to a ``host:port`` instance and return the
+    server's JSON (``{"status": ..., "accepted": ...}``, HTTP 202 — the
+    drain completes after the response). Failures raise; the controller
+    records them as a failed action and backs off."""
+
+    def __init__(self, timeout_s: float = 5.0, scheme: str = "http"):
+        self.timeout_s = float(timeout_s)
+        self.scheme = scheme
+
+    def drain(self, instance: str) -> dict:
+        req = urllib.request.Request(
+            f"{self.scheme}://{instance}/drain", data=b"", method="POST",
+            headers=tracing.outbound_headers({
+                "Accept": "application/json",
+                "User-Agent": "tpu-k8s-controller",
+            }),
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            body = resp.read().decode("utf-8", "replace")
+        try:
+            out = json.loads(body)
+        except ValueError:
+            out = {}
+        return out if isinstance(out, dict) else {}

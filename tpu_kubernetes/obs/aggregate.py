@@ -18,10 +18,12 @@ failure counts survive across cycles, so one dead worker reads as
 
 from __future__ import annotations
 
+import json
 import math
 import random
 import threading
 import time
+import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field, replace
@@ -60,6 +62,10 @@ class TargetHealth:
     last_success_ts: float = 0.0
     backoff_s: float = 0.0       # current penalty (0 = none / disabled)
     next_scrape_ts: float = 0.0  # skip scrapes until this timestamp
+    # instance lifecycle from GET /healthz ("serving"/"warming"/
+    # "draining"/"failed"; "" = unknown or probing disabled) — what
+    # distinguishes a draining instance from a merely saturated one
+    lifecycle: str = ""
 
 
 @dataclass
@@ -154,6 +160,7 @@ class ScrapeResult:
     seconds: float
     families: list[expfmt.Family] = field(default_factory=list)
     error: str = ""
+    lifecycle: str = ""
 
 
 def _normalize_target(target: str) -> tuple[str, str]:
@@ -175,12 +182,18 @@ class FleetAggregator:
 
     def __init__(self, targets: list[str], timeout_s: float = 2.0,
                  retries: int = 1, max_workers: int = 16,
-                 backoff_base_s: float = 0.0, tsdb=None, alerts=None):
+                 backoff_base_s: float = 0.0, tsdb=None, alerts=None,
+                 probe_health: bool = False):
         self._targets = [_normalize_target(t) for t in targets]
         if not self._targets:
             raise ValueError("FleetAggregator needs at least one target")
         self.timeout_s = timeout_s
         self.retries = max(0, int(retries))
+        # probe_health=True adds one GET /healthz per target per cycle,
+        # recording the instance lifecycle (serving/warming/draining/
+        # failed) alongside the scrape — bare exporters without a
+        # healthz endpoint simply read as "" (unknown)
+        self.probe_health = bool(probe_health)
         # backoff_base_s > 0 (callers pass their poll interval) arms
         # jittered exponential backoff for dead targets: consecutive
         # failures double the re-poll delay up to BACKOFF_CAP_MULT x the
@@ -218,8 +231,39 @@ class FleetAggregator:
         with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
             return resp.read().decode("utf-8", "replace")
 
+    def _probe_lifecycle(self, instance: str, url: str) -> str:
+        """GET the target's ``/healthz`` and map its ``status`` to the
+        lifecycle label the monitor shows: ``ok`` → ``serving``; the
+        503 states (``warming``/``draining``/``failed``) carry their
+        status in the error body. Anything unparsable — a bare metrics
+        exporter with no healthz — reads as ``""`` (unknown)."""
+        probe = f"{url.split('://', 1)[0]}://{instance}/healthz"
+        req = urllib.request.Request(probe, headers=tracing.outbound_headers({
+            "Accept": "application/json", "User-Agent": "tpu-k8s-monitor",
+        }))
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                body = resp.read()
+        except urllib.error.HTTPError as e:
+            try:
+                body = e.read()
+            except Exception:  # noqa: BLE001 — probe is best-effort
+                return ""
+        except Exception:  # noqa: BLE001 — probe is best-effort
+            return ""
+        try:
+            status = json.loads(body.decode("utf-8", "replace")).get("status")
+        except Exception:  # noqa: BLE001 — non-JSON healthz
+            return ""
+        if not isinstance(status, str):
+            return ""
+        return "serving" if status == "ok" else status
+
     def _scrape_target(self, instance: str, url: str) -> ScrapeResult:
         last_error = ""
+        lifecycle = (
+            self._probe_lifecycle(instance, url) if self.probe_health else ""
+        )
         t0 = time.monotonic()
         for _ in range(self.retries + 1):
             try:
@@ -231,10 +275,12 @@ class FleetAggregator:
             return ScrapeResult(
                 instance=instance, ok=True,
                 seconds=time.monotonic() - t0, families=families,
+                lifecycle=lifecycle,
             )
         return ScrapeResult(
             instance=instance, ok=False,
             seconds=time.monotonic() - t0, error=last_error,
+            lifecycle=lifecycle,
         )
 
     def health(self) -> dict[str, TargetHealth]:
@@ -344,6 +390,7 @@ class FleetAggregator:
             for r in results:
                 h = self._health[r.instance]
                 h.up = 1 if r.ok else 0
+                h.lifecycle = r.lifecycle
                 h.last_scrape_seconds = round(r.seconds, 6)
                 if r.ok:
                     h.consecutive_failures = 0

@@ -956,6 +956,12 @@ class AlertManager:
         # evaluation's alert list — temporally overlapping firing
         # alerts become one incident bundle
         self.incidents = incidents
+        # subscribers beyond the correlator: anything with an
+        # ``observe(alerts, now=, snapshot=)`` method (duck-typed — the
+        # FleetController in obs/controller.py registers here) sees the
+        # same alert list the correlator does, after it, so an acting
+        # listener reads the incident the correlator just opened
+        self.listeners: list = []
         self._silences: list[Silence] = []
         self._tracked: dict[str, _Tracked] = {}
         self._lock = threading.Lock()
@@ -1015,6 +1021,12 @@ class AlertManager:
             try:
                 self.incidents.observe(alerts, now=ctx.now)
             except Exception:  # noqa: BLE001 — correlation must not
+                pass           # fail the evaluation loop
+        for listener in list(self.listeners):
+            try:
+                listener.observe(alerts, now=ctx.now,
+                                 snapshot=ctx.snapshot)
+            except Exception:  # noqa: BLE001 — a listener must not
                 pass           # fail the evaluation loop
         return alerts
 

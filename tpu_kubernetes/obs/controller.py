@@ -1,0 +1,761 @@
+"""The closed loop: an observability-driven fleet controller.
+
+PRs 12–17 built the sensing half of the "self-driving fleet" ROADMAP
+item — rule alerts, incident bundles, per-role saturation, the goodput
+ledger, distributed tracing. This module is the acting half, with one
+governing rule: **every decision is itself observed**. An action that
+isn't recorded, metered, and traceable didn't happen.
+
+Three cooperating pieces:
+
+* :class:`FleetRouter` — placement. Routes a request to the least
+  loaded *eligible* instance, scored by the aggregator's
+  ``tpu_serve_saturation`` gauge plus per-cycle
+  ``tpu_serve_kv_page_stalls_total`` deltas (page pressure), skipping
+  draining/failed/down instances. Prefix-sticky: the same prompt prefix
+  re-routes to the instance whose prefix KV cache is already warm, so
+  warm-prefill wins multiply fleet-wide — stickiness yields only when
+  the pinned instance saturates.
+* :class:`FleetController` — remediation. Subscribes to the
+  AlertManager stream (``manager.listeners``) and maps rule kinds to a
+  **closed action vocabulary** (:data:`ACTION_KINDS`, statically
+  checked by ``tpu-kubernetes analyze`` exactly like fault sites and
+  alert kinds): ``queue_runaway``/``slo_burn`` → ``scale_up`` through
+  the Terraform executor path; ``engine_restart`` loops →
+  ``drain_replace``; sustained idle with a low
+  ``tpu_serve_slot_bubble_fraction`` → ``scale_down`` via
+  ``POST /drain`` so resident work never drops. **Goodput, not raw
+  RPS, is the scaling signal**: decisions read saturation, queue
+  pressure, and the ledger's useful-token share — request rate is
+  never consulted — and a degraded goodput vetoes scale-down.
+* :class:`ActionLedger` — the audit trail. Every action — proposed,
+  executed, failed, or suppressed by dry-run/cooldown — is appended to
+  a bounded ring and an optional JSONL sink, counted by
+  ``tpu_fleet_actions_total{kind,outcome}``, written into the incident
+  bundle that triggered it, and stamped with the triggering alert
+  fingerprint and a trace id — an incident reads as detect → decide →
+  actuate → resolve.
+
+Safety is structural, not aspirational: actions default to dry-run
+(``TPU_K8S_CONTROLLER_DRY_RUN``), a per-kind cooldown and a
+max-actions-per-cycle cap bound the blast radius, each alert
+fingerprint gets at most one actuation (no duplicate Terraform
+invocations per alert), failures retry with bounded exponential
+backoff, and the whole actuation path runs through the
+``fleet.remediate`` fault site so the loop is chaos-testable on CPU.
+
+Knobs (all through util/envparse.py, documented in
+docs/guide/observability.md):
+
+* ``TPU_K8S_CONTROLLER_DRY_RUN`` — record-don't-act (default on).
+* ``TPU_K8S_CONTROLLER_COOLDOWN_S`` — per-action-kind hold-down.
+* ``TPU_K8S_CONTROLLER_MAX_ACTIONS`` — actuations per cycle cap.
+* ``TPU_K8S_CONTROLLER_MIN_REPLICAS`` / ``_MAX_REPLICAS`` — scale
+  clamps.
+* ``TPU_K8S_ACTIONS_FILE`` / ``TPU_K8S_ACTIONS_KEEP`` — JSONL sink
+  path and ring size for the action ledger.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, TextIO
+
+from tpu_kubernetes.obs import REGISTRY, tracing
+from tpu_kubernetes.obs.aggregate import FleetSnapshot
+from tpu_kubernetes.obs.faults import FAULTS
+from tpu_kubernetes.util.envparse import (
+    env_bool,
+    env_float,
+    env_int,
+    env_str,
+)
+
+# the closed action-kind vocabulary. Adding a kind = add it here AND
+# document it in the docs/guide/observability.md action table; the
+# static contracts pass (`tpu-kubernetes analyze`) fails CI on a
+# new_action() literal that exists only in code (action-kind-unknown)
+# or a registered kind missing from the guide (action-kind-undocumented)
+# — the same two-way check fault sites and alert kinds get.
+ACTION_KINDS = frozenset({
+    "scale_up",       # add a replica via the Terraform executor path
+    "scale_down",     # drain one instance (POST /drain), then shrink
+    "drain_replace",  # drain a sick instance and re-apply its module
+})
+
+# every action record lands in exactly one of these
+OUTCOMES = ("proposed", "executed", "failed", "suppressed")
+
+ACTIONS_TOTAL = REGISTRY.counter(
+    "tpu_fleet_actions_total",
+    "fleet controller actions by kind and outcome (proposed/executed/"
+    "failed/suppressed) — executed>0 with dry-run intended means the "
+    "controller is live",
+    labelnames=("kind", "outcome"),
+)
+
+ENV_DRY_RUN = "TPU_K8S_CONTROLLER_DRY_RUN"
+ENV_COOLDOWN_S = "TPU_K8S_CONTROLLER_COOLDOWN_S"
+ENV_MAX_ACTIONS = "TPU_K8S_CONTROLLER_MAX_ACTIONS"
+ENV_MIN_REPLICAS = "TPU_K8S_CONTROLLER_MIN_REPLICAS"
+ENV_MAX_REPLICAS = "TPU_K8S_CONTROLLER_MAX_REPLICAS"
+ENV_ACTIONS_FILE = "TPU_K8S_ACTIONS_FILE"
+ENV_ACTIONS_KEEP = "TPU_K8S_ACTIONS_KEEP"
+
+ACTION_SCHEMA = "tpu-k8s-action/1"
+
+# metric families the router/controller read from a FleetSnapshot
+_SATURATION = "tpu_serve_saturation"
+_PAGE_STALLS = "tpu_serve_kv_page_stalls_total"
+_BUBBLE = "tpu_serve_slot_bubble_fraction"
+_TOKENS_EMITTED = "tpu_serve_tokens_emitted_total"
+_TOKENS_CLASS = "tpu_serve_tokens_total"
+
+
+def new_action(kind: str, **fields: Any) -> dict[str, Any]:
+    """The one choke point that mints action records — the analyzer
+    checks every literal first argument against :data:`ACTION_KINDS`
+    (action-kind-unknown), and the runtime enforces the same contract
+    for dynamic callers."""
+    if kind not in ACTION_KINDS:
+        raise ValueError(
+            f"unknown action kind {kind!r} (registered: "
+            f"{sorted(ACTION_KINDS)})"
+        )
+    action: dict[str, Any] = {
+        "schema": ACTION_SCHEMA,
+        "id": "",
+        "ts": 0.0,
+        "kind": kind,
+        "outcome": "proposed",
+        "rule": "",
+        "alert_fingerprint": "",
+        "trace_id": "",
+        "incident_id": "",
+        "target": "",
+        "reason": "",
+        "error": "",
+        "attempt": 0,
+        "signal": {},
+    }
+    action.update(fields)
+    if action["outcome"] not in OUTCOMES:
+        raise ValueError(f"unknown action outcome {action['outcome']!r}")
+    return action
+
+
+class ActionLedger:
+    """Bounded in-memory ring of action records plus an optional
+    append-only JSONL sink — the `get actions` CLI reads the file, the
+    tests read the ring, and ``tpu_fleet_actions_total`` counts every
+    record either way."""
+
+    def __init__(self, path: str | Path | None = None, keep: int = 256):
+        self.path = Path(path) if path else None
+        self.keep = max(1, int(keep))
+        self._lock = threading.Lock()
+        self._ring: collections.deque[dict] = collections.deque(
+            maxlen=self.keep
+        )
+
+    @classmethod
+    def from_env(cls, env: dict | None = None) -> "ActionLedger":
+        return cls(
+            path=env_str(ENV_ACTIONS_FILE, "", env=env) or None,
+            keep=env_int(ENV_ACTIONS_KEEP, 256, env=env),
+        )
+
+    def record(self, action: dict[str, Any]) -> dict[str, Any]:
+        ACTIONS_TOTAL.labels(action["kind"], action["outcome"]).inc()
+        with self._lock:
+            self._ring.append(action)
+            if self.path is not None:
+                try:
+                    self.path.parent.mkdir(parents=True, exist_ok=True)
+                    with self.path.open("a", encoding="utf-8") as f:
+                        f.write(json.dumps(action, sort_keys=True) + "\n")
+                except OSError:
+                    pass  # the ring (and the metric) still have it
+        return action
+
+    def actions(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def tail(self, n: int) -> list[dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)[-max(0, int(n)):]
+
+
+def list_actions(path: str | Path) -> list[dict[str, Any]]:
+    """Read a JSONL action ledger tolerantly (half-written tail lines
+    are skipped — the sink appends live)."""
+    out: list[dict[str, Any]] = []
+    try:
+        text = Path(path).read_text(encoding="utf-8")
+    except OSError:
+        return out
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+def render_actions(actions: list[dict[str, Any]]) -> str:
+    """The human rendering for ``get actions`` — one line per record,
+    newest last, audit-trail columns first."""
+    if not actions:
+        return "no recorded actions\n"
+    lines = [
+        f"{'TS':<19} {'KIND':<13} {'OUTCOME':<10} {'RULE':<18} "
+        f"{'FPRINT':<12} {'TARGET':<21} REASON"
+    ]
+    for a in actions:
+        ts = a.get("ts") or 0
+        when = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts)) \
+            if ts else "-"
+        reason = a.get("reason") or ""
+        if a.get("error"):
+            reason = f"{reason} [{a['error']}]".strip()
+        lines.append(
+            f"{when:<19} {a.get('kind', '-'):<13}"
+            f" {a.get('outcome', '-'):<10}"
+            f" {(a.get('rule') or '-'):<18}"
+            f" {(a.get('alert_fingerprint') or '-'):<12}"
+            f" {(a.get('target') or '-'):<21}"
+            f" {reason}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def _squash(x: float, half: float) -> float:
+    return x / (x + half) if x > 0 else 0.0
+
+
+class FleetRouter:
+    """Saturation-, page-pressure-, and prefix-affinity-aware placement
+    over the latest :class:`FleetSnapshot`. ``update`` digests a scrape
+    cycle; ``route`` picks an instance for a prompt."""
+
+    # page-stall delta at which the pressure component scores 0.5
+    STALL_HALF = 4.0
+    # weight of page pressure relative to the saturation score
+    STALL_WEIGHT = 0.5
+
+    def __init__(self, prefix_chars: int = 64, sticky_max: int = 512,
+                 sticky_ceiling: float = 0.9):
+        self.prefix_chars = int(prefix_chars)
+        self.sticky_max = int(sticky_max)
+        self.sticky_ceiling = float(sticky_ceiling)
+        self._lock = threading.Lock()
+        self._sticky: collections.OrderedDict[int, str] = \
+            collections.OrderedDict()
+        self._score: dict[str, float] = {}
+        self._sat: dict[str, float] = {}
+        self._eligible: list[str] = []
+        self._stall_prev: dict[str, float] = {}
+
+    def update(self, snapshot: FleetSnapshot) -> None:
+        score: dict[str, float] = {}
+        sat: dict[str, float] = {}
+        eligible: list[str] = []
+        for instance in snapshot.instances():
+            mine = (lambda i: lambda labels:
+                    labels.get("instance") == i)(instance)
+            s = max((smp.value for smp in snapshot._samples(
+                _SATURATION, _SATURATION, mine)), default=0.0)
+            stalls = snapshot.value_sum(_PAGE_STALLS, mine)
+            prev = self._stall_prev.get(instance, stalls)
+            delta = stalls - prev
+            if delta < 0:  # counter reset (worker restart)
+                delta = stalls
+            self._stall_prev[instance] = stalls
+            sat[instance] = s
+            score[instance] = s + self.STALL_WEIGHT * _squash(
+                delta, self.STALL_HALF
+            )
+            health = snapshot.health[instance]
+            if health.up and health.lifecycle not in ("draining", "failed"):
+                eligible.append(instance)
+        with self._lock:
+            self._score, self._sat = score, sat
+            self._eligible = eligible
+
+    def eligible(self) -> list[str]:
+        with self._lock:
+            return list(self._eligible)
+
+    def saturation(self, instance: str) -> float:
+        with self._lock:
+            return self._sat.get(instance, 0.0)
+
+    def route(self, prompt: str = "") -> str | None:
+        """Pick an instance: prefix-sticky while the pinned instance is
+        eligible and below the sticky ceiling, else the lowest combined
+        saturation + page-pressure score (and re-pin the prefix there —
+        its prefill warms that instance's prefix cache)."""
+        key = hash(prompt[: self.prefix_chars]) if prompt else None
+        with self._lock:
+            if not self._eligible:
+                return None
+            if key is not None:
+                pinned = self._sticky.get(key)
+                if (pinned in self._eligible
+                        and self._score.get(pinned, 0.0)
+                        < self.sticky_ceiling):
+                    self._sticky.move_to_end(key)
+                    return pinned
+            best = min(self._eligible,
+                       key=lambda i: (self._score.get(i, 0.0), i))
+            if key is not None:
+                self._sticky[key] = best
+                self._sticky.move_to_end(key)
+                while len(self._sticky) > self.sticky_max:
+                    self._sticky.popitem(last=False)
+            return best
+
+
+def fleet_goodput(snapshot: FleetSnapshot | None) -> float | None:
+    """The ledger's useful share of every emitted token, fleet-wide —
+    None until any worker has emitted anything."""
+    if snapshot is None:
+        return None
+    emitted = snapshot.value_sum(_TOKENS_EMITTED)
+    if not emitted:
+        return None
+    useful = snapshot.value_sum(
+        _TOKENS_CLASS, lambda labels: labels.get("class") == "useful"
+    )
+    return round(useful / emitted, 4)
+
+
+class FleetController:
+    """Maps the alert stream to remediations under hard guards; every
+    decision lands in the action ledger and the triggering incident
+    bundle. Register on a manager with
+    ``manager.listeners.append(controller)`` — ``observe`` runs inside
+    the evaluate cycle with the same snapshot the rules saw."""
+
+    def __init__(self, *, executor=None, scaler=None, drainer=None,
+                 incidents=None, ledger: ActionLedger | None = None,
+                 router: FleetRouter | None = None,
+                 dry_run: bool | None = None,
+                 cooldown_s: float | None = None,
+                 max_actions: int | None = None,
+                 min_replicas: int | None = None,
+                 max_replicas: int | None = None,
+                 idle_saturation: float = 0.05,
+                 idle_hold_s: float = 120.0,
+                 bubble_ceiling: float = 0.25,
+                 goodput_floor: float = 0.9,
+                 retry_backoff_s: float = 30.0,
+                 max_retries: int = 2,
+                 clock: Callable[[], float] = time.time,
+                 env: dict | None = None):
+        self.dry_run = env_bool(ENV_DRY_RUN, True, env=env) \
+            if dry_run is None else bool(dry_run)
+        self.cooldown_s = env_float(ENV_COOLDOWN_S, 300.0, env=env) \
+            if cooldown_s is None else float(cooldown_s)
+        self.max_actions = env_int(ENV_MAX_ACTIONS, 1, env=env) \
+            if max_actions is None else int(max_actions)
+        self.min_replicas = env_int(ENV_MIN_REPLICAS, 1, env=env) \
+            if min_replicas is None else int(min_replicas)
+        self.max_replicas = env_int(ENV_MAX_REPLICAS, 8, env=env) \
+            if max_replicas is None else int(max_replicas)
+        if scaler is None and executor is not None:
+            from tpu_kubernetes.fleet.scaler import FleetScaler
+            scaler = FleetScaler(executor, replicas=self.min_replicas)
+        if drainer is None:
+            from tpu_kubernetes.fleet.scaler import HTTPDrainer
+            drainer = HTTPDrainer()
+        self.scaler = scaler
+        self.drainer = drainer
+        self.incidents = incidents
+        self.ledger = ledger if ledger is not None \
+            else ActionLedger.from_env(env=env)
+        self.router = router if router is not None else FleetRouter()
+        self.idle_saturation = float(idle_saturation)
+        self.idle_hold_s = float(idle_hold_s)
+        self.bubble_ceiling = float(bubble_ceiling)
+        self.goodput_floor = float(goodput_floor)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.max_retries = max(0, int(max_retries))
+        self._clock = clock
+        self._lock = threading.Lock()
+        # per-fingerprint actuation state: at most one executed action
+        # per alert episode, bounded retries with backoff in between
+        self._handled: dict[str, dict[str, Any]] = {}
+        self._last_kind_ts: dict[str, float] = {}
+        self._idle_since: float | None = None
+        self._seq = 0
+
+    # -- placement ---------------------------------------------------------
+
+    def route(self, prompt: str = "") -> str | None:
+        return self.router.route(prompt)
+
+    # -- remediation -------------------------------------------------------
+
+    def replicas(self) -> int:
+        if self.scaler is not None:
+            return int(self.scaler.replicas)
+        return self.min_replicas
+
+    def actions(self) -> list[dict[str, Any]]:
+        return self.ledger.actions()
+
+    def observe(self, alerts: list[dict[str, Any]],
+                now: float | None = None,
+                snapshot: FleetSnapshot | None = None) -> list[dict]:
+        """One controller cycle: digest the snapshot for routing, map
+        firing alerts (plus sustained idleness) to decisions, and run
+        each through the guard gauntlet. Returns the action records
+        emitted this cycle. Never raises — this runs inside the alert
+        evaluate loop."""
+        now = float(self._clock() if now is None else now)
+        if snapshot is not None:
+            try:
+                self.router.update(snapshot)
+            except Exception:  # noqa: BLE001 — routing must not stop acting
+                pass
+        emitted: list[dict] = []
+        with self._lock:
+            self._started = 0  # the per-cycle actuation cap
+            try:
+                decisions = self._decide(alerts or [], snapshot, now)
+                for decision in decisions:
+                    emitted.extend(self._act(decision, now))
+            except Exception:  # noqa: BLE001 — a controller bug must not
+                pass           # take the monitor loop down with it
+        return emitted
+
+    def _decide(self, alerts: list[dict[str, Any]],
+                snapshot: FleetSnapshot | None,
+                now: float) -> list[dict[str, Any]]:
+        decisions: list[dict[str, Any]] = []
+        goodput = fleet_goodput(snapshot)
+        firing = False
+        for a in alerts:
+            fp = a.get("fingerprint", "")
+            state = a.get("state")
+            if state == "resolved":
+                # episode over: a future re-fire is a new decision
+                self._handled.pop(fp, None)
+                continue
+            if state != "firing" or a.get("silenced"):
+                continue
+            firing = True
+            kind = a.get("kind", "")
+            inst = (a.get("labels") or {}).get("instance", "")
+            base = {
+                "fingerprint": fp,
+                "rule": a.get("rule", ""),
+                "target": inst,
+                "signal": {"goodput": goodput,
+                           "alert_kind": kind,
+                           "value": a.get("value")},
+            }
+            if kind in ("queue_runaway", "slo_burn"):
+                decisions.append(dict(
+                    base, kind="scale_up",
+                    reason=a.get("summary") or f"{kind} firing",
+                ))
+            elif kind == "engine_restart":
+                decisions.append(dict(
+                    base, kind="drain_replace",
+                    reason=a.get("summary") or "engine restart loop",
+                ))
+        # scale-down is snapshot-driven, not alert-driven: sustained
+        # idleness + low slot-bubble fraction + healthy goodput (a fleet
+        # that is shedding or wasting work is not safe to shrink)
+        if firing or snapshot is None:
+            self._idle_since = None
+            return decisions
+        eligible = self.router.eligible()
+        sats = [self.router.saturation(i) for i in eligible]
+        n = max(1, len(snapshot.instances()))
+        bubble = snapshot.value_sum(_BUBBLE) / n
+        idle = (
+            bool(eligible)
+            and max(sats) < self.idle_saturation
+            and bubble <= self.bubble_ceiling
+            and (goodput is None or goodput >= self.goodput_floor)
+            and self.replicas() > self.min_replicas
+        )
+        if not idle:
+            self._idle_since = None
+            return decisions
+        if self._idle_since is None:
+            self._idle_since = now
+        if now - self._idle_since >= self.idle_hold_s:
+            target = min(eligible, key=lambda i: (
+                self.router.saturation(i), i))
+            decisions.append({
+                "kind": "scale_down",
+                "fingerprint": f"idle:{target}",
+                "rule": "",
+                "target": target,
+                "reason": (
+                    f"fleet idle {now - self._idle_since:.0f}s "
+                    f"(max saturation {max(sats):.3f}, "
+                    f"bubble {bubble:.3f})"
+                ),
+                "signal": {"goodput": goodput,
+                           "max_saturation": max(sats),
+                           "bubble_fraction": round(bubble, 4)},
+            })
+        return decisions
+
+    def _act(self, decision: dict[str, Any], now: float) -> list[dict]:
+        fp = decision["fingerprint"]
+        kind = decision["kind"]
+        ent = self._handled.get(fp)
+        if ent is not None and (ent["done"] or now < ent["next_ts"]):
+            return []  # already acted, or holding down before a retry
+        if self._started >= self.max_actions:
+            return []  # per-cycle cap: remaining decisions wait a cycle
+        emitted: list[dict] = []
+        if ent is None:
+            ent = self._handled[fp] = {
+                "kind": kind, "attempts": 0, "done": False,
+                "next_ts": 0.0,
+                "trace_id": tracing.current_trace_id()
+                or tracing.new_trace_id(),
+            }
+            emitted.append(self._emit(decision, "proposed", ent, now))
+            if self.dry_run:
+                ent["done"] = True
+                emitted.append(self._emit(
+                    decision, "suppressed", ent, now,
+                    reason=f"dry-run ({ENV_DRY_RUN}=1)",
+                ))
+                return emitted
+            last = self._last_kind_ts.get(kind)
+            if last is not None and now - last < self.cooldown_s:
+                ent["done"] = True
+                emitted.append(self._emit(
+                    decision, "suppressed", ent, now,
+                    reason=(f"cooldown: {kind} acted "
+                            f"{now - last:.0f}s ago "
+                            f"(hold-down {self.cooldown_s:g}s)"),
+                ))
+                return emitted
+            clamp = self._clamp_reason(kind)
+            if clamp:
+                ent["done"] = True
+                emitted.append(self._emit(
+                    decision, "suppressed", ent, now, reason=clamp,
+                ))
+                return emitted
+        self._started += 1
+        try:
+            FAULTS.fire("fleet.remediate")
+            detail = self._actuate(decision)
+        except Exception as exc:  # noqa: BLE001 — any actuation failure
+            ent["attempts"] += 1   # rides the same bounded-retry path
+            error = f"{type(exc).__name__}: {exc}"[:200]
+            if ent["attempts"] > self.max_retries:
+                ent["done"] = True
+                error += " (retries exhausted)"
+            else:
+                ent["next_ts"] = now + self.retry_backoff_s * (
+                    2.0 ** (ent["attempts"] - 1)
+                )
+            emitted.append(self._emit(
+                decision, "failed", ent, now, error=error,
+            ))
+        else:
+            ent["done"] = True
+            self._last_kind_ts[kind] = now
+            if kind == "scale_down":
+                self._idle_since = None
+            emitted.append(self._emit(
+                decision, "executed", ent, now, **detail,
+            ))
+        return emitted
+
+    # per-cycle actuation counter (reset at the top of observe())
+    _started = 0
+
+    def _clamp_reason(self, kind: str) -> str:
+        if kind == "scale_up" and self.replicas() >= self.max_replicas:
+            return (f"at max replicas ({self.max_replicas}, "
+                    f"{ENV_MAX_REPLICAS})")
+        if kind == "scale_down" and self.replicas() <= self.min_replicas:
+            return (f"at min replicas ({self.min_replicas}, "
+                    f"{ENV_MIN_REPLICAS})")
+        return ""
+
+    def _actuate(self, decision: dict[str, Any]) -> dict[str, Any]:
+        kind = decision["kind"]
+        target = decision.get("target", "")
+        if kind == "scale_up":
+            if self.scaler is None:
+                raise RuntimeError("scale_up without a scaler/executor")
+            n = min(self.max_replicas, self.replicas() + 1)
+            self.scaler.scale_to(n)
+            return {"replicas": n}
+        if kind == "scale_down":
+            if not target:
+                raise RuntimeError("scale_down without a target instance")
+            drain = self.drainer.drain(target)
+            n = self.replicas()
+            if self.scaler is not None:
+                n = max(self.min_replicas, n - 1)
+                self.scaler.scale_to(n)
+            return {"replicas": n,
+                    "drain": {"status": drain.get("status"),
+                              "accepted": drain.get("accepted")}}
+        if kind == "drain_replace":
+            detail: dict[str, Any] = {}
+            if target:
+                try:
+                    detail["drain"] = {
+                        "status": self.drainer.drain(target).get("status")
+                    }
+                except Exception as exc:  # noqa: BLE001 — a sick instance
+                    # may not answer its drain; replacement still proceeds
+                    detail["drain"] = {
+                        "error": f"{type(exc).__name__}: {exc}"[:120]
+                    }
+            if self.scaler is None:
+                raise RuntimeError("drain_replace without a scaler/executor")
+            self.scaler.replace(target or "fleet")
+            return detail
+        raise RuntimeError(f"unmapped action kind {kind!r}")
+
+    def _emit(self, decision: dict[str, Any], outcome: str,
+              ent: dict[str, Any], now: float, *,
+              error: str = "", reason: str | None = None,
+              **extra: Any) -> dict[str, Any]:
+        self._seq += 1
+        incident_id = ""
+        if self.incidents is not None:
+            try:
+                incident_id = self.incidents.current_incident_id() or ""
+            except Exception:  # noqa: BLE001 — audit trail is best-effort
+                pass
+        action = new_action(
+            decision["kind"],
+            id=f"act-{self._seq}",
+            ts=round(now, 3),
+            outcome=outcome,
+            rule=decision.get("rule", ""),
+            alert_fingerprint=decision.get("fingerprint", ""),
+            trace_id=ent["trace_id"],
+            incident_id=incident_id,
+            target=decision.get("target", ""),
+            reason=reason if reason is not None
+            else decision.get("reason", ""),
+            error=error,
+            attempt=ent["attempts"],
+            signal=dict(decision.get("signal") or {}),
+        )
+        if extra:
+            action["signal"].update(extra)
+        self.ledger.record(action)
+        if self.incidents is not None:
+            try:
+                self.incidents.note_action(action, now=now)
+            except Exception:  # noqa: BLE001 — bundle write must not
+                pass            # block the actuation path
+        return action
+
+
+def run_controller(targets: list[str], interval: float = 5.0,
+                   once: bool = False, as_json: bool = False,
+                   out: TextIO | None = None,
+                   max_cycles: int | None = None,
+                   timeout_s: float = 2.0,
+                   dry_run: bool | None = None,
+                   executor=None) -> int:
+    """The ``fleet control`` CLI loop: scrape the fleet, evaluate the
+    standard rules, and let the controller remediate — dry-run unless
+    ``--apply`` (or ``TPU_K8S_CONTROLLER_DRY_RUN=0``) says otherwise.
+    One status line (or JSON object) per cycle."""
+    import os
+
+    from tpu_kubernetes.obs import alerts as alerts_mod
+    from tpu_kubernetes.obs.aggregate import FleetAggregator
+    from tpu_kubernetes.obs.incidents import IncidentCorrelator
+    from tpu_kubernetes.obs.tsdb import TSDB
+    from tpu_kubernetes.shell.executor import default_executor
+
+    out = sys.stdout if out is None else out
+    store = TSDB()
+    rules = alerts_mod.default_fleet_rules()
+    rules_d = os.environ.get("TPU_K8S_ALERTS_D", "")
+    if rules_d:
+        try:
+            rules += alerts_mod.load_rules(rules_d)
+        except Exception as e:  # noqa: BLE001 — operator error, not a crash
+            print(f"warning: TPU_K8S_ALERTS_D: {e}", file=sys.stderr)
+    incidents = IncidentCorrelator.from_env(dict(os.environ))
+    manager = alerts_mod.AlertManager(
+        rules, sinks=alerts_mod.sinks_from_env(), incidents=incidents,
+    )
+    controller = FleetController(
+        executor=executor if executor is not None else default_executor(),
+        incidents=incidents, dry_run=dry_run,
+    )
+    manager.listeners.append(controller)
+    aggregator = FleetAggregator(
+        targets, timeout_s=timeout_s,
+        backoff_base_s=0.0 if once else interval,
+        tsdb=store, alerts=manager, probe_health=True,
+    )
+    cycles = 0
+    try:
+        while True:
+            seen = len(controller.ledger.actions())
+            snapshot = aggregator.scrape_once()
+            fresh = controller.ledger.actions()[seen:]
+            firing = [
+                a for a in manager.active()
+                if a.get("state") in ("pending", "firing")
+            ]
+            if as_json:
+                print(json.dumps({
+                    "ts": snapshot.ts,
+                    "dry_run": controller.dry_run,
+                    "replicas": controller.replicas(),
+                    "instances": {
+                        i: {"up": h.up, "state": h.lifecycle or None}
+                        for i, h in snapshot.health.items()
+                    },
+                    "alerts": firing,
+                    "actions": fresh,
+                }, sort_keys=True), file=out, flush=True)
+            else:
+                up = sum(h.up for h in snapshot.health.values())
+                line = (
+                    f"fleet-control up={up}/{len(snapshot.health)}"
+                    f" replicas={controller.replicas()}"
+                    f" firing={len(firing)}"
+                    f"{' [dry-run]' if controller.dry_run else ''}"
+                )
+                for a in fresh:
+                    line += (f"\n  action {a['id']}: {a['kind']}"
+                             f" -> {a['outcome']}"
+                             f"{' — ' + a['reason'] if a['reason'] else ''}")
+                print(line, file=out, flush=True)
+            cycles += 1
+            if once or (max_cycles is not None and cycles >= max_cycles):
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        manager.close()

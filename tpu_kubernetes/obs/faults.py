@@ -51,6 +51,7 @@ SITES = frozenset({
     "serve.prefix_insert",  # prefix KV-cache store insert (best-effort)
     "serve.page_alloc",     # PagePool.allocate (paged admission/top-up)
     "fleet.scrape",         # FleetAggregator per-target fetch
+    "fleet.remediate",      # FleetController actuation (obs/controller.py)
     "shell.terraform",      # TerraformExecutor subprocess run
     "obs.alert_sink",       # alert notification delivery (obs/alerts.py)
     "obs.trace_export",     # span exporter delivery (obs/tracing.py)

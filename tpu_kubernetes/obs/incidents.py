@@ -178,6 +178,10 @@ class IncidentCorrelator:
             "closed_at": None,
             "alerts": {},
             "flightrec_dumps": [],
+            # the controller's audit trail: every action record emitted
+            # while this incident is open (obs/controller.py) — the
+            # bundle reads detect → decide → actuate → resolve
+            "actions": [],
         }
         for a in firing:
             inc["alerts"][a.get("fingerprint", "")] = self._member(
@@ -220,7 +224,8 @@ class IncidentCorrelator:
         events.emit("incident_close", incident_id=inc["incident_id"],
                     fingerprints=sorted(inc["alerts"]),
                     duration_s=round(now - inc["opened_at"], 3),
-                    dumps=len(inc["flightrec_dumps"]))
+                    dumps=len(inc["flightrec_dumps"]),
+                    actions=len(inc.get("actions", [])))
 
     # -- the flight-recorder cross-ref -------------------------------------
 
@@ -241,6 +246,22 @@ class IncidentCorrelator:
                     self._open["flightrec_dumps"].append(path)
             else:
                 self._recent_dumps.append((now, path))
+
+    # -- the fleet-controller cross-ref -------------------------------------
+
+    def note_action(self, action: dict, now: float | None = None) -> None:
+        """A controller action record (obs/controller.py) landed while
+        this incident is open: append it to the bundle's audit trail
+        and rewrite. Actions with no open incident only live in the
+        action ledger — the standalone audit trail."""
+        now = time.time() if now is None else now
+        with self._lock:
+            if self._open is None:
+                return
+            inc = self._open
+            inc.setdefault("actions", []).append(dict(action))
+            inc["updated_at"] = now
+            self._write(inc, now)
 
     # -- the bundle ---------------------------------------------------------
 
@@ -303,6 +324,7 @@ class IncidentCorrelator:
             "rules": sorted({m.get("rule") for m in
                              inc["alerts"].values()}),
             "flightrec_dumps": list(inc["flightrec_dumps"]),
+            "actions": list(inc.get("actions", [])),
             "faults_injected": self._fault_totals(),
             "ledger": self._ledger_block(),
             "history": self._history_block(inc),
@@ -400,6 +422,14 @@ def render_incidents(payloads: list[dict]) -> str:
                 f"{'{' + labels + '}' if labels else ''}"
                 f" severity={m.get('severity') or '-'}"
                 + (f" — {m['summary']}" if m.get("summary") else "")
+            )
+        for a in p.get("actions", []):
+            lines.append(
+                f"    action {a.get('id') or '-'}: {a.get('kind')}"
+                f" -> {a.get('outcome')}"
+                + (f" target={a['target']}" if a.get("target") else "")
+                + (f" — {a['reason']}" if a.get("reason") else "")
+                + (f" [{a['error']}]" if a.get("error") else "")
             )
         ledger = p.get("ledger", {})
         loss = ledger.get("loss_breakdown", {})

@@ -115,6 +115,11 @@ def fleet_rows(snapshot: FleetSnapshot,
         row: dict[str, Any] = {
             "instance": instance,
             "up": health.up,
+            # lifecycle from the healthz probe (serving/warming/
+            # draining/failed; None when probing is off or the target
+            # has no healthz) — distinguishes a draining instance from
+            # a merely saturated one
+            "state": health.lifecycle or None,
             # per-instance build version (tpu_k8s_build_info) — a mixed
             # column during a rollout is the point of carrying it here
             "version": snapshot.label_value(BUILD_INFO, "version", mine),
@@ -180,7 +185,8 @@ def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
     there since the tracker rows already show them)."""
     with_trends = any("spark" in row for row in rows)
     header = (
-        f"{'INSTANCE':<24} {'UP':>2} {'VER':>8} {'ROLE':>8} {'RPS':>8} "
+        f"{'INSTANCE':<24} {'UP':>2} {'VER':>8} {'ROLE':>8} {'STATE':>9} "
+        f"{'RPS':>8} "
         f"{'P50':>8} {'P99':>8} {'TTFT99':>8} {'TOK/S':>8} {'QUEUE':>6} "
         f"{'SAT':>6} {'GOODPUT':>8}"
     )
@@ -199,6 +205,7 @@ def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
             f"{row['instance']:<24} {row['up']:>2}"
             f" {(row.get('version') or '-'):>8}"
             f" {(row.get('role') or '-'):>8}"
+            f" {(row.get('state') or '-'):>9}"
             f"{_fmt(row['rps'])}"
             f"{_fmt(row['p50_s'], 's', 9)}"
             f"{_fmt(row['p99_s'], 's', 9)}"
@@ -298,6 +305,8 @@ def run_monitor(targets: list[str], interval: float = 5.0,
         targets, timeout_s=timeout_s,
         backoff_base_s=0.0 if once else interval,
         tsdb=store,
+        # the STATE column: one healthz probe per target per cycle
+        probe_health=True,
     )
     trackers = default_slos(store=store) if slos is None else slos
     manager = alert_manager
