@@ -3,7 +3,7 @@
 
 VERSION := $(shell python -c "import tpu_kubernetes; print(tpu_kubernetes.__version__)")
 
-.PHONY: test test-fast analysis-check jax-check obs-check monitor-check flightrec-check alerts-check trace-check controller-check perf-check goodput-check serve-identity-check serve-continuous-check paged-check sharded-check resilience-check bench dryrun native dist dist-offline clean
+.PHONY: test test-fast analysis-check jax-check obs-check monitor-check flightrec-check alerts-check trace-check controller-check perf-check goodput-check serve-identity-check serve-continuous-check paged-check sharded-check spec-check resilience-check bench dryrun native dist dist-offline clean
 
 test:
 	python -m pytest tests/ -q
@@ -13,7 +13,7 @@ test:
 native:
 	python -c "from tpu_kubernetes import native; assert native.available(), 'native build failed'; print('native runtime OK')"
 
-test-fast: analysis-check jax-check trace-check controller-check
+test-fast: analysis-check jax-check trace-check controller-check spec-check
 	python -m pytest tests/ -q -m "not slow"
 
 # Invariant-analyzer gate: the AST contract passes (closed vocabularies,
@@ -178,6 +178,23 @@ paged-check:
 	  tests/test_serve_continuous.py tests/test_serve_sharded.py \
 	  tests/test_faults.py tests/test_perfbench.py \
 	  -q -k paged
+
+# Speculative-decoding gate: everything named "spec" or "ngram" — the
+# host n-gram proposer units and verify-primitive identity tests
+# (test_decode.py), the engine token-identity suite (ngram and draft
+# proposers, dense/paged/int8 vs solo greedy, plus proposal refill,
+# test_serve_continuous.py), the 2-device-mesh spec identity
+# (test_serve_sharded.py), the serve.spec_verify chaos matrix
+# (test_faults.py), and the counter-based acceptance criterion
+# (>= 1.5 emitted tokens per row per verify round on the repetitive-
+# suffix trace, test_perfbench.py — slow-marked, so tier-1 skips it
+# but this target runs it). docs/guide/serving.md "Speculative
+# continuous batching".
+spec-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py \
+	  tests/test_serve_continuous.py tests/test_serve_sharded.py \
+	  tests/test_faults.py tests/test_perfbench.py \
+	  -q -k "spec or ngram"
 
 # Sharded continuous-batching gate: the token-identity suite on the
 # forced 2-device CPU mesh (dense/paged/int8/warm-prefix/MoE gather +

@@ -817,3 +817,179 @@ def test_paged_decode_identity_with_solo_decode(params, kv_quant):
             break
     for i in range(3):
         assert collected[i] == refs[i], f"paged row {i} diverged"
+
+
+# ---------------------------------------------------------------------------
+# speculative verify primitives: the (slots, draft_k+1) window must be
+# bitwise the sequential slot engine no matter what the drafts say
+# (ISSUE 20) — `make spec-check` / `make serve-identity-check`
+# ---------------------------------------------------------------------------
+
+
+class TestNgramProposeHost:
+    """Host-side proposer edge cases (models/speculative.py). The slot
+    engine calls this between verify rounds; a wrong proposal can only
+    cost rounds, but the edge cases below must not raise or return
+    short arrays — the verify program's (slots, k) draft shape is
+    fixed."""
+
+    def _propose(self, ctx, n, k, last=99):
+        from tpu_kubernetes.models.speculative import ngram_propose_host
+
+        return ngram_propose_host(ctx, n, k, last)
+
+    def test_ngram_matches_latest_continuation(self):
+        # (1, 2) at 0 (→3) and 3 (→4): the LATER occurrence proposes
+        assert self._propose([1, 2, 3, 1, 2, 4, 1, 2], 2, 2) == [4, 1]
+
+    def test_ngram_empty_prompt_falls_back(self):
+        assert self._propose([], 2, 3, last=7) == [7, 7, 7]
+
+    def test_ngram_draft_k_larger_than_prompt(self):
+        # k=6 over a 4-token ctx: the LATEST match (start=2) has a
+        # one-token continuation, padded with `last` to the full fixed
+        # k — never a short array
+        assert self._propose([5, 5, 5, 5], 1, 6, last=8) \
+            == [5, 8, 8, 8, 8, 8]
+
+    def test_ngram_longer_than_ctx_falls_back(self):
+        assert self._propose([3], 3, 2, last=4) == [4, 4]
+
+    def test_ngram_match_at_ctx_end_pads_with_last(self):
+        # tail (2, 3) matches at start 0; its continuation (the tail
+        # itself) runs out of context after 2 tokens → padded with last
+        assert self._propose([2, 3, 2, 3], 2, 3, last=6) == [2, 3, 6]
+
+    def test_ngram_rejects_bad_args(self):
+        with pytest.raises(ValueError, match="ngram"):
+            self._propose([1, 2, 3], 0, 2)
+        with pytest.raises(ValueError, match="draft_k"):
+            self._propose([1, 2, 3], 2, 0)
+
+
+def _spec_verify_loop(params, kv_quant, paged):
+    """Drive decode_verify_slots / decode_verify_paged to drain over
+    mixed-width rows, alternating n-gram proposals with adversarial
+    garbage drafts round by round, and return per-row token lists."""
+    from tpu_kubernetes.models.decode import (
+        SlotState,
+        cache_insert_row,
+        decode_verify_paged,
+        decode_verify_slots,
+        init_cache,
+        init_paged_pool,
+        paged_insert_row,
+    )
+    from tpu_kubernetes.models.speculative import ngram_propose_host
+
+    k = 4
+    plens = [6, 11, 9]
+    widths = [8, 16, 16]
+    budgets = [9, 4, 6]
+    slots = 3
+    prompts = [
+        jax.random.randint(jax.random.PRNGKey(60 + i), (1, n), 0,
+                           CFG.vocab_size)
+        for i, n in enumerate(plens)
+    ]
+
+    rows, firsts = [], []
+    for i in range(slots):
+        padded = jnp.pad(prompts[i], ((0, 0), (0, widths[i] - plens[i])))
+        logits, row = prefill(
+            params, padded, CFG, max_seq=widths[i],
+            lengths=jnp.asarray([plens[i]], jnp.int32),
+            kv_quant=kv_quant,
+        )
+        rows.append(row)
+        firsts.append(int(np.argmax(np.asarray(logits)[0])))
+
+    w = jnp.asarray(widths, jnp.int32)
+    st = SlotState(
+        tok=jnp.asarray(firsts, jnp.int32), pos=w,
+        remaining=jnp.asarray([b - 1 for b in budgets], jnp.int32),
+        prompt_lengths=jnp.asarray(plens, jnp.int32), prompt_slots=w)
+
+    if paged:
+        ps = 8
+        max_pages = CFG.max_seq // ps
+        pool = init_paged_pool(CFG, slots * max_pages + 1, ps,
+                               kv_quant=kv_quant)
+        table = np.zeros((slots, max_pages), np.int32)
+        nxt = 1
+        for i, row in enumerate(rows):
+            pages = list(range(nxt, nxt + max_pages))
+            nxt += max_pages
+            table[i, :] = pages
+            pool = paged_insert_row(
+                pool, row, jnp.asarray(pages[:widths[i] // ps], jnp.int32))
+        table = jnp.asarray(table)
+        run = lambda st, store, d: decode_verify_paged(
+            params, store, table, st, d, CFG, eos_id=None, pad_id=0)
+    else:
+        cache = init_cache(CFG, slots, CFG.max_seq, kv_quant=kv_quant)
+        for i, row in enumerate(rows):
+            cache = cache_insert_row(cache, row, i)
+        run = lambda st, store, d: decode_verify_slots(
+            params, store, st, d, CFG, eos_id=None, pad_id=0)
+        pool = cache
+
+    collected = [[firsts[i]] for i in range(slots)]
+    pos_h = np.asarray(st.pos).copy()
+    rounds = 0
+    while int(np.asarray(st.remaining).sum()) > 0 and rounds < 64:
+        if rounds % 2:
+            # adversarial round: pure garbage — identity must survive
+            drafts = np.full((slots, k), CFG.vocab_size - 1, np.int32)
+        else:
+            drafts = np.stack([
+                np.asarray(ngram_propose_host(
+                    np.asarray(prompts[i])[0].tolist() + collected[i],
+                    2, k, collected[i][-1]), np.int32)
+                for i in range(slots)])
+        toks, st, pool = run(st, pool, jnp.asarray(drafts))
+        toks = np.asarray(toks)
+        new_pos = np.asarray(st.pos)
+        for i in range(slots):
+            got = int(new_pos[i] - pos_h[i])
+            collected[i].extend(toks[i][:got].tolist())
+        pos_h = new_pos.copy()
+        rounds += 1
+    return collected
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+@pytest.mark.parametrize("paged", [False, True])
+def test_spec_verify_identity_with_solo_decode(params, kv_quant, paged):
+    """The tentpole identity: a verify loop over the (slots, draft_k+1)
+    window — ragged acceptance, per-row position rewind, proposals good
+    one round and adversarial the next — must emit EXACTLY what each
+    row emits decoded solo, fp32 AND int8, dense AND paged. Rejected
+    drafts leave quantized garbage past the accepted position; the next
+    window must overwrite it before it is ever attendable."""
+    from tpu_kubernetes.models.decode import decode_segment
+
+    plens = [6, 11, 9]
+    widths = [8, 16, 16]
+    budgets = [9, 4, 6]
+    refs = []
+    for i in range(3):
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(60 + i), (1, plens[i]), 0, CFG.vocab_size)
+        padded = jnp.pad(prompt, ((0, 0), (0, widths[i] - plens[i])))
+        logits, cache = prefill(
+            params, padded, CFG, max_seq=CFG.max_seq,
+            lengths=jnp.asarray([plens[i]], jnp.int32),
+            kv_quant=kv_quant,
+        )
+        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        toks, _, _, _ = decode_segment(
+            params, cache, first, jnp.zeros((1,), bool), CFG,
+            steps=budgets[i] - 1,
+        )
+        refs.append([int(first[0])] + np.asarray(toks)[0].tolist())
+
+    collected = _spec_verify_loop(params, kv_quant, paged)
+    for i in range(3):
+        assert collected[i] == refs[i], \
+            f"{'paged' if paged else 'dense'} spec row {i} diverged"

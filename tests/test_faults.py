@@ -97,9 +97,9 @@ def test_site_vocabulary_is_closed():
     test fails here until the matrix learns about it."""
     assert set(SITES) == {
         "serve.prefill", "serve.slot_insert", "serve.segment",
-        "serve.shard_segment", "serve.prefix_insert", "serve.page_alloc",
-        "fleet.scrape", "fleet.remediate", "shell.terraform",
-        "obs.alert_sink", "obs.trace_export",
+        "serve.shard_segment", "serve.spec_verify", "serve.prefix_insert",
+        "serve.page_alloc", "fleet.scrape", "fleet.remediate",
+        "shell.terraform", "obs.alert_sink", "obs.trace_export",
     }
     assert ENV_VAR == "TPU_K8S_FAULTS"
 
@@ -432,6 +432,98 @@ def _restart_resets_pool_cold(state):
 
 def test_paged_engine_restart_resets_pool_cold(paged_chaos_server):
     _restart_resets_pool_cold(paged_chaos_server.RequestHandlerClass.state)
+
+
+# ---------------------------------------------------------------------------
+# speculative-engine chaos: serve.spec_verify mid-segment (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+# the speculating engine replaces plain segments with verify rounds, so
+# serve.spec_verify sits on ITS decode hot path (never fired by the
+# plain fixtures above); serve.segment rides along to prove the
+# engine-level fault handling is unchanged by the spec loop
+SPEC_SITES = ["serve.spec_verify", "serve.segment"]
+
+
+@pytest.fixture(scope="module")
+def spec_chaos_server():
+    """A speculating PAGED server (prompt lookup + page pool + prefix
+    cache): rejected-draft cells flow to the speculative-waste ledger
+    class and page-table truncates return pages every round, so both
+    conservation invariants are live while verify rounds fail."""
+    from tpu_kubernetes.serve.server import make_server
+
+    srv = make_server(dict(
+        ENV, SERVER_HOST="127.0.0.1", SERVER_PORT="0",
+        SERVE_CONTINUOUS_BATCHING="1", SERVER_BATCH="2",
+        SERVE_PREFIX_CACHE_MB="4",
+        SERVE_KV_POOL_MB="0.25", SERVE_KV_PAGE_SIZE="16",
+        SERVE_PROMPT_LOOKUP="1", SERVE_DRAFT_K="4",
+    ))
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.mark.parametrize("prob", [1.0, 0.5])
+@pytest.mark.parametrize("site", SPEC_SITES)
+def test_spec_chaos_terminates_conserves_ledger_and_pages(
+    spec_chaos_server, site, prob,
+):
+    """A verify round failing mid-segment: every request reaches a
+    terminal state, the ledger conservation invariant holds WITH
+    speculative-waste in play (classes sum to emitted — completed
+    rounds settled their cells before the fault fired), every page is
+    back on an accountable list, and the same engine serves clean
+    traffic immediately after."""
+    from tpu_kubernetes.obs.ledger import LEDGER
+
+    state = spec_chaos_server.RequestHandlerClass.state
+    assert state._engine.spec_source == "ngram"
+    before = LEDGER.snapshot(timeline=0)
+    with injected(f"{site}:{prob}:11"):
+        outs = _fan_out_chaotic(state, PROMPTS)
+    for o in outs:
+        assert o is not None                     # terminal, not hung
+        assert isinstance(o, (dict, Exception))
+    # chaos over: clean traffic immediately, then settlement converges
+    ok = state.complete("pack my box", max_new_tokens=3)
+    assert ok["text"]
+    deadline = time.time() + 10
+    while (time.time() < deadline
+           and LEDGER.unsettled() != before["unsettled"]):
+        time.sleep(0.02)
+    after = LEDGER.snapshot(timeline=0)
+    assert after["unsettled"] == before["unsettled"]
+    assert (sum(after["classes"].values()) - sum(before["classes"].values())
+            == after["emitted"] - before["emitted"])
+    assert after["emitted"] > before["emitted"]
+    _assert_pages_conserved(state)
+
+
+def test_spec_clean_run_settles_speculative_waste(spec_chaos_server):
+    """No faults armed: the speculating engine's rejected draft cells
+    land in the speculative-waste class (nonzero — this random-init
+    model rejects most proposals) while conservation stays exact."""
+    from tpu_kubernetes.obs.ledger import LEDGER
+
+    state = spec_chaos_server.RequestHandlerClass.state
+    before = LEDGER.snapshot(timeline=0)
+    outs = _fan_out_chaotic(state, PROMPTS)
+    assert all(isinstance(o, dict) for o in outs)
+    deadline = time.time() + 10
+    while (time.time() < deadline
+           and LEDGER.unsettled() != before["unsettled"]):
+        time.sleep(0.02)
+    after = LEDGER.snapshot(timeline=0)
+    assert after["unsettled"] == before["unsettled"]
+    assert (sum(after["classes"].values()) - sum(before["classes"].values())
+            == after["emitted"] - before["emitted"])
+    waste = (after["classes"].get("speculative-waste", 0)
+             - before["classes"].get("speculative-waste", 0))
+    assert waste > 0
+    _assert_pages_conserved(state)
 
 
 # ---------------------------------------------------------------------------

@@ -6,9 +6,11 @@ cold and warm-prefix, including a request admitted mid-decode while
 other rows hold their slots). Alongside identity: the engine's
 observability surface (slot-occupancy gauge, admission-wait histogram,
 recycled counter, /healthz engine stats) and the config gating
-(MoE builds the engine — no fall-back — and prompt-lookup stays
-exclusive). The sharded (SERVE_MESH) engine has its own identity suite
-in tests/test_serve_sharded.py.
+(MoE builds the engine — no fall-back — and speculation COMPOSES:
+SERVE_PROMPT_LOOKUP / SERVE_DRAFT_MODEL arm the engine's per-round
+(slots, draft_k+1) verify step instead of being rejected). The sharded
+(SERVE_MESH) engine has its own identity suite in
+tests/test_serve_sharded.py.
 """
 
 import http.client
@@ -271,11 +273,16 @@ def test_continuous_builds_for_moe():
     assert st._batcher is None
 
 
-def test_continuous_rejects_prompt_lookup():
-    with pytest.raises(ValueError, match="exclusive"):
-        ServingState(dict(
-            ENV, SERVE_CONTINUOUS_BATCHING="1", SERVE_PROMPT_LOOKUP="1",
-        ))
+def test_continuous_composes_with_prompt_lookup():
+    """The old exclusivity rejection is GONE: prompt lookup + the slot
+    engine build one engine with the n-gram proposer armed (the verify
+    step replaces per-token segments; no round-based fall-back)."""
+    st = ServingState(dict(
+        ENV, SERVE_CONTINUOUS_BATCHING="1", SERVE_PROMPT_LOOKUP="1",
+    ))
+    assert st._engine is not None
+    assert st._engine.spec_source == "ngram"
+    assert st._batcher is None
 
 
 # ---------------------------------------------------------------------------
@@ -521,3 +528,117 @@ def test_token_identity_survives_segment_failure(solo_state, cont_state):
     for out, ref in zip(outs, refs):
         assert out["text"] == ref["text"]
         assert out["tokens"] == ref["tokens"]
+
+
+# ---------------------------------------------------------------------------
+# speculative continuous batching (ISSUE 20): the engine's per-round
+# (slots, draft_k+1) verify step — ngram and draft-model proposers —
+# must be token-invisible. `make spec-check` / `make serve-identity-check`
+# ---------------------------------------------------------------------------
+
+SPEC_NGRAM = dict(SERVE_CONTINUOUS_BATCHING="1", SERVER_BATCH="4",
+                  SERVE_PROMPT_LOOKUP="1", SERVE_DRAFT_K="4")
+
+
+@pytest.fixture(scope="module")
+def spec_state():
+    """The slot engine with the host n-gram proposer armed."""
+    return _state(**SPEC_NGRAM)
+
+
+def test_spec_ngram_identity_with_solo_greedy(solo_state, spec_state):
+    """Mixed staggered batch through the speculating engine == solo
+    greedy token-for-token; the drafted/rounds totals prove the verify
+    path (not per-token segments) actually served the rows."""
+    assert spec_state._engine.spec_source == "ngram"
+    before = dict(spec_state.spec_totals)
+    refs = [
+        solo_state.complete(p, max_new_tokens=b)
+        for p, b in zip(PROMPTS, BUDGETS)
+    ]
+    outs = _fan_out(spec_state, PROMPTS, BUDGETS)
+    for out, ref in zip(outs, refs):
+        assert out["text"] == ref["text"]
+        assert out["tokens"] == ref["tokens"]
+    after = dict(spec_state.spec_totals)
+    assert after["rounds"] > before["rounds"]
+    assert after["drafted"] > before["drafted"]
+    assert after["accepted"] <= after["drafted"]
+
+
+def test_spec_paged_identity_with_solo_greedy(solo_state):
+    """Same contract through the page table: ragged verify, per-row
+    page-table truncate returning rejected-extent pages to the pool —
+    and every page back on an accountable list once rows drain."""
+    st = _state(SERVE_KV_POOL_MB="0.5", SERVE_KV_PAGE_SIZE="16",
+                **SPEC_NGRAM)
+    refs = [
+        solo_state.complete(p, max_new_tokens=b)
+        for p, b in zip(PROMPTS, BUDGETS)
+    ]
+    outs = _fan_out(st, PROMPTS, BUDGETS)
+    for out, ref in zip(outs, refs):
+        assert out["text"] == ref["text"]
+        assert out["tokens"] == ref["tokens"]
+    _settle(lambda: st._engine.stats()["occupied"] == 0)
+    s = st._engine._pages.stats()
+    assert s["free"] + s["live"] + s["pinned"] == s["total"]
+
+
+def test_spec_draft_model_identity_with_solo_greedy(solo_state):
+    """The int8-KV draft model proposes instead of the n-gram table
+    (SERVE_DRAFT_MODEL wins); proposals never change tokens."""
+    st = _state(SERVE_CONTINUOUS_BATCHING="1", SERVER_BATCH="4",
+                SERVE_DRAFT_MODEL="llama-test", SERVE_DRAFT_K="4",
+                SERVE_DRAFT_KV_QUANT="1")
+    assert st._engine.spec_source == "draft"
+    refs = [
+        solo_state.complete(p, max_new_tokens=b)
+        for p, b in zip(PROMPTS, BUDGETS)
+    ]
+    outs = _fan_out(st, PROMPTS, BUDGETS)
+    for out, ref in zip(outs, refs):
+        assert out["text"] == ref["text"]
+        assert out["tokens"] == ref["tokens"]
+
+
+def test_spec_int8_identity_with_plain_engine():
+    """Speculation over the quantized KV cache: rejected-draft garbage
+    is quantized garbage, overwritten before it is ever attendable —
+    the int8 speculating engine must match the int8 PLAIN engine
+    bitwise (int8 vs fp32 differs by design, so the reference is the
+    plain engine, not solo fp32)."""
+    spec = _state(SERVE_KV_QUANT="1", **SPEC_NGRAM)
+    plain = _state(SERVE_KV_QUANT="1", SERVE_CONTINUOUS_BATCHING="1",
+                   SERVER_BATCH="4")
+    refs = _fan_out(plain, PROMPTS, BUDGETS)
+    outs = _fan_out(spec, PROMPTS, BUDGETS)
+    for out, ref in zip(outs, refs):
+        assert out["text"] == ref["text"]
+        assert out["tokens"] == ref["tokens"]
+
+
+def test_spec_proposal_refill_after_partial_acceptance(spec_state):
+    """The per-slot proposal buffer refills from prompt+emitted after
+    every verify round: a period-2 prompt at this seed sustains real
+    PARTIAL acceptance (some drafts land, some are rejected), so a
+    stale or unreplenished buffer would either stall the loop or break
+    identity. Asserts 0 < accepted < drafted plus multi-token rounds,
+    and that the buffer is cleared when the slot is released."""
+    solo = _state(SERVE_EARLY_EXIT_STEPS="0",
+                  SERVE_MAX_NEW=spec_state.env["SERVE_MAX_NEW"])
+    text, budget = "ababababababab", 16
+    before = dict(spec_state.spec_totals)
+    out = spec_state.complete(text, max_new_tokens=budget)
+    ref = solo.complete(text, max_new_tokens=budget)
+    assert out["tokens"] == ref["tokens"]
+    assert out["text"] == ref["text"]
+    after = dict(spec_state.spec_totals)
+    accepted = after["accepted"] - before["accepted"]
+    drafted = after["drafted"] - before["drafted"]
+    rounds = after["rounds"] - before["rounds"]
+    assert 0 < accepted < drafted
+    # partial acceptance means strictly fewer verify rounds than tokens
+    assert rounds < budget - 1
+    _settle(lambda: spec_state._engine.stats()["occupied"] == 0)
+    assert all(p == [] for p in spec_state._engine._proposals)

@@ -97,12 +97,20 @@ def test_prompt_lookup_disabled_by_falsy_values(tmp_path, prompts_file):
     assert len(out) == 3
 
 
-def test_lookup_and_draft_exclusive(tmp_path, prompts_file):
-    with pytest.raises(SystemExit, match="exclusive"):
-        run_serving(_env(
-            prompts_file, tmp_path / "o.txt",
-            SERVE_PROMPT_LOOKUP="1", SERVE_DRAFT_MODEL="llama-test",
-        ))
+def test_lookup_and_draft_together_draft_wins(tmp_path, prompts_file):
+    """Both proposers configured is no longer an error: the draft model
+    wins and lookup is ignored (logged), so the run completes with
+    exactly the draft-assisted output."""
+    draft_only = run_serving(_env(
+        prompts_file, tmp_path / "a.txt",
+        SERVE_DRAFT_MODEL="llama-test", SERVE_DRAFT_K="3",
+    ))
+    both = run_serving(_env(
+        prompts_file, tmp_path / "b.txt",
+        SERVE_PROMPT_LOOKUP="1", SERVE_DRAFT_MODEL="llama-test",
+        SERVE_DRAFT_K="3",
+    ))
+    assert both == draft_only
 
 
 def test_kv_quant_rejected_in_speculative_modes(tmp_path, prompts_file):

@@ -130,6 +130,31 @@ def test_sharded_identity_int8_kv():
     assert got == ref
 
 
+def test_sharded_identity_spec_ngram(ref_texts):
+    """Speculative verify composes with the mesh day one: the ragged
+    (slots, draft_k+1) verify program runs through the same kv_jit
+    builder as plain segments, SlotState and drafts replicated, KV
+    sharded on heads — sharded speculating == dense single-device."""
+    st = _state(SERVE_MESH="tensor=2", SERVE_PROMPT_LOOKUP="1",
+                SERVE_DRAFT_K="4")
+    assert st._engine is not None and st._engine.spec_source == "ngram"
+    assert _texts(st) == ref_texts
+    assert st.spec_totals["rounds"] > 0
+
+
+def test_sharded_identity_spec_paged(ref_texts):
+    """Speculation over the SHARDED paged pool: verify windows scatter
+    through the page table, truncate returns rejected-extent pages —
+    still token-identical to the dense single-device engine."""
+    st = _state(SERVE_MESH="tensor=2", SERVE_PROMPT_LOOKUP="1",
+                SERVE_DRAFT_K="4", SERVE_KV_POOL_MB="0.5",
+                SERVE_KV_PAGE_SIZE="16")
+    assert st._engine is not None and st._engine.paged
+    assert _texts(st) == ref_texts
+    s = st._engine._pages.stats()
+    assert s["free"] + s["live"] + s["pinned"] == s["total"]
+
+
 def test_sharded_identity_warm_prefix(ref_state, sharded_state):
     """Prefix-cache hits resume through the sharded prefill_resume
     program (host arrays reshard on entry): warm rows and cold rows in

@@ -24,6 +24,8 @@ from tpu_kubernetes.models.decode import (  # noqa: F401
     decode_segment_slots,
     decode_step,
     decode_step_slots,
+    decode_verify_paged,
+    decode_verify_slots,
     generate,
     init_cache,
     init_slot_state,
@@ -33,6 +35,7 @@ from tpu_kubernetes.models.decode import (  # noqa: F401
 )
 from tpu_kubernetes.models.speculative import (  # noqa: F401
     SpecStats,
+    ngram_propose_host,
     prompt_lookup_generate,
     speculative_generate,
 )

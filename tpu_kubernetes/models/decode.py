@@ -909,6 +909,144 @@ def decode_segment_slots(
     return toks.T, st, cache
 
 
+def _verify_accept(
+    greedy: jax.Array, drafts: jax.Array, st: SlotState,
+    *, eos_id: int | None, pad_id: int,
+) -> tuple[jax.Array, SlotState]:
+    """Ragged per-row draft acceptance — the batched form of the
+    ``_spec_loop`` rule (models/speculative.py): row i keeps the longest
+    prefix of ``drafts[i]`` the target's ``greedy[i]`` agrees with, plus
+    one correction token, clipped to the row's remaining budget and to
+    the first emitted EOS. The emitted tokens are BY CONSTRUCTION the
+    target's own greedy choices at valid context (matched prefix ⇒ the
+    window context equals the sequential context), so acceptance decides
+    how many tokens come out of a round, never which ones. Dead rows
+    (remaining == 0) emit ``pad_id`` and freeze — the segment liveness
+    rule, unchanged. → (emitted (b, k+1) int32, advanced state); the
+    cache "rollback" is the position arithmetic itself: ``pos`` advances
+    by the accepted length only, and the rejected window slots above it
+    are masked until the next round's window rewrites them."""
+    b, c = greedy.shape
+    k = c - 1
+    off = jnp.arange(c, dtype=jnp.int32)
+    matches = (drafts == greedy[:, :k]).astype(jnp.int32)
+    j = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)        # (b,) 0..k
+    n_acc = jnp.minimum(j + 1, st.remaining)                 # budget clip
+    if eos_id is not None:
+        is_eos = greedy == eos_id
+        eos_idx = jnp.min(
+            jnp.where(is_eos, off[None, :], c), axis=1
+        )                                                    # (b,) c = none
+        n_emit = jnp.minimum(n_acc, eos_idx + 1)
+        hit_eos = eos_idx < n_acc          # EOS inside the emitted prefix
+        rem = jnp.where(hit_eos, 0, st.remaining - n_emit)
+    else:
+        n_emit = n_acc
+        rem = st.remaining - n_emit
+    emitted = jnp.where(off[None, :] < n_emit[:, None], greedy, pad_id)
+    last = jnp.take_along_axis(
+        greedy, jnp.clip(n_emit - 1, 0, k)[:, None], axis=1
+    )[:, 0]
+    return emitted, st._replace(
+        tok=jnp.where(n_emit > 0, last, st.tok),
+        pos=st.pos + n_emit,
+        remaining=rem,
+    )
+
+
+def decode_verify_slots(
+    params: dict, cache: KVCache, st: SlotState, drafts: jax.Array,
+    cfg: ModelConfig, *, eos_id: int | None = None, pad_id: int = 0,
+) -> tuple[jax.Array, SlotState, KVCache]:
+    """ONE speculative verify round over the slot engine's mixed batch:
+    every row runs the target once over its ``draft_k+1``-token window
+    ``[st.tok[i], drafts[i]]`` at slots ``pos..pos+k`` (per-row gapless
+    RoPE positions, per-row causal limits — :func:`decode_step_slots`
+    widened from c=1 to c=k+1), then accepts per :func:`_verify_accept`.
+    K/V for the whole window land in the cache before attention reads
+    them, so window row i attends exactly the sequential context when
+    rows 0..i-1 matched; rejected slots above the accepted position stay
+    masked (``limits``) and the NEXT round's window — which always
+    starts at or below them — rewrites them before they can ever be
+    attended. The shape is fixed at (slots, draft_k+1) whatever the
+    acceptance pattern, so the retrace sentinel sees one program per
+    (engine, draft_k) signature. → (emitted (b, k+1) int32 padded with
+    ``pad_id``, state advanced by each row's accepted length, cache);
+    the host reads each row's emission count off the ``pos`` delta,
+    exactly the segment contract."""
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    b, k = drafts.shape
+    c = k + 1
+    h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    off = jnp.arange(c, dtype=jnp.int32)
+    positions = (
+        st.prompt_lengths + (st.pos - st.prompt_slots)
+    )[:, None] + off[None, :]                                # (b, c)
+    limits = (st.pos + 1)[:, None] + off[None, :]            # (b, c)
+    b_idx = jnp.arange(b)[:, None, None]
+    kv_idx = jnp.arange(kv)[None, :, None]
+    # (b, 1, c): broadcasts with b_idx/kv_idx to one (b, kv, c) scatter;
+    # a drained row's frozen window may poke past max_seq — those
+    # scatters drop (the dense analog of dead rows writing the sink)
+    slot_idx = (st.pos[:, None] + off[None, :])[:, None, :]
+    chunk = jnp.concatenate([st.tok[:, None], drafts], axis=1)
+    x = params["embed"][chunk]                               # (b, c, d)
+
+    def block(carry, xs):
+        x, (k_all, v_all, ks_all, vs_all) = carry
+        layer, li = xs
+        y = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = (y @ _w(layer["wq"], cfg.dtype)).reshape(b, c, h, hd)
+        q = q.transpose(0, 2, 1, 3)
+        kc = (y @ _w(layer["wk"], cfg.dtype)).reshape(b, c, kv, hd)
+        kc = kc.transpose(0, 2, 1, 3)
+        vc = (y @ _w(layer["wv"], cfg.dtype)).reshape(b, c, kv, hd)
+        vc = vc.transpose(0, 2, 1, 3)                        # (b, kv, c, hd)
+        q = apply_rope(q, cos, sin, positions=positions)
+        kc = apply_rope(kc, cos, sin, positions=positions)
+        if ks_all is not None:
+            kc, k_sc = _quantize_kv(kc)
+            vc, v_sc = _quantize_kv(vc)
+            ks_all = ks_all.at[li, b_idx, kv_idx, slot_idx].set(
+                k_sc, mode="drop")
+            vs_all = vs_all.at[li, b_idx, kv_idx, slot_idx].set(
+                v_sc, mode="drop")
+        kc = kc.astype(k_all.dtype)
+        vc = vc.astype(v_all.dtype)
+        k_all = k_all.at[li, b_idx, kv_idx, slot_idx].set(kc, mode="drop")
+        v_all = v_all.at[li, b_idx, kv_idx, slot_idx].set(vc, mode="drop")
+        k_cache = jax.lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)
+        v_cache = jax.lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)
+        k_scale = v_scale = None
+        if ks_all is not None:
+            k_scale = jax.lax.dynamic_index_in_dim(
+                ks_all, li, 0, keepdims=False)
+            v_scale = jax.lax.dynamic_index_in_dim(
+                vs_all, li, 0, keepdims=False)
+        attn = _attend_cache(cfg, q, k_cache, v_cache, limits,
+                             st.prompt_lengths, st.prompt_slots,
+                             k_scale=k_scale, v_scale=v_scale)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, c, h * hd)
+        x = x + attn @ _w(layer["wo"], cfg.dtype)
+        return (_mlp(cfg, x, layer), (k_all, v_all, ks_all, vs_all)), None
+
+    n_layers = cache.k.shape[0]
+    (x, (k_new, v_new, ks_new, vs_new)), _ = jax.lax.scan(
+        block,
+        (x, (cache.k, cache.v, cache.k_scale, cache.v_scale)),
+        (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # (b, c)
+    emitted, st = _verify_accept(
+        greedy, drafts, st, eos_id=eos_id, pad_id=pad_id,
+    )
+    return emitted, st, cache._replace(
+        k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new,
+    )
+
+
 # ---------------------------------------------------------------------------
 # paged KV cache: a fixed page pool + per-slot page tables
 # ---------------------------------------------------------------------------
@@ -1225,3 +1363,106 @@ def decode_segment_paged(
         step, (pool, st), None, length=steps
     )
     return toks.T, st, pool
+
+
+def decode_verify_paged(
+    params: dict, pool: PagedKVCache, table: jax.Array, st: SlotState,
+    drafts: jax.Array, cfg: ModelConfig, *, eos_id: int | None = None,
+    pad_id: int = 0,
+) -> tuple[jax.Array, SlotState, PagedKVCache]:
+    """:func:`decode_verify_slots` through a page table: each row's
+    ``draft_k+1`` window positions ``pos..pos+k`` scatter at
+    (table[row, p // ps], p % ps) and attention reads the row's pages
+    gathered into the (max_pages * ps)-wide virtual dense row — same
+    masks, same acceptance, so paged speculative decode stays bitwise
+    the dense engine's. The host guarantees every live row's table
+    covers ``pos + min(k+1, remaining) - 1`` before calling (the
+    emittable extent — the pre-round top-up); window positions past a
+    row's budget may fall on unallocated entries and land in the page-0
+    sink, which is harmless: acceptance can never emit past
+    ``remaining``, so a greedy argmax polluted by a sink read is always
+    clipped out of both the emission and the EOS/match tests. The
+    host-side rollback is a page-table TRUNCATE: after readback the
+    engine returns pages past each row's accepted position to the pool
+    (serve/pages.py refcount discipline) — the paged analog of the
+    dense position rewind. → (emitted (b, k+1), state, pool)."""
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    b, mp = table.shape
+    ps = pool.k.shape[3]
+    k = drafts.shape[1]
+    c = k + 1
+    h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    off = jnp.arange(c, dtype=jnp.int32)
+    positions = (
+        st.prompt_lengths + (st.pos - st.prompt_slots)
+    )[:, None] + off[None, :]                                # (b, c)
+    limits = (st.pos + 1)[:, None] + off[None, :]            # (b, c)
+    p = st.pos[:, None] + off[None, :]                       # (b, c)
+    # gather clamps a frozen row's out-of-range page index; its write
+    # then collides on whatever page that is only with itself or the
+    # sink — never-read, any winner (the dead-row rule)
+    page_idx = jnp.take_along_axis(table, p // ps, axis=1)[:, None, :]
+    off_idx = (p % ps)[:, None, :]                           # (b, 1, c)
+    kv_idx = jnp.arange(kv)[None, :, None]
+    chunk = jnp.concatenate([st.tok[:, None], drafts], axis=1)
+    x = params["embed"][chunk]                               # (b, c, d)
+
+    def virtual(pool_l):
+        a = pool_l[table]                    # (b, mp, kv, ps[, hd])
+        if a.ndim == 5:
+            a = a.transpose(0, 2, 1, 3, 4)
+        else:
+            a = a.transpose(0, 2, 1, 3)
+        return a.reshape(a.shape[:2] + (mp * ps,) + a.shape[4:])
+
+    def block(carry, xs):
+        x, (k_all, v_all, ks_all, vs_all) = carry
+        layer, li = xs
+        y = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = (y @ _w(layer["wq"], cfg.dtype)).reshape(b, c, h, hd)
+        q = q.transpose(0, 2, 1, 3)
+        kc = (y @ _w(layer["wk"], cfg.dtype)).reshape(b, c, kv, hd)
+        kc = kc.transpose(0, 2, 1, 3)
+        vc = (y @ _w(layer["wv"], cfg.dtype)).reshape(b, c, kv, hd)
+        vc = vc.transpose(0, 2, 1, 3)                        # (b, kv, c, hd)
+        q = apply_rope(q, cos, sin, positions=positions)
+        kc = apply_rope(kc, cos, sin, positions=positions)
+        if ks_all is not None:
+            kc, k_sc = _quantize_kv(kc)
+            vc, v_sc = _quantize_kv(vc)
+            ks_all = ks_all.at[li, page_idx, kv_idx, off_idx].set(k_sc)
+            vs_all = vs_all.at[li, page_idx, kv_idx, off_idx].set(v_sc)
+        kc = kc.astype(k_all.dtype)
+        vc = vc.astype(v_all.dtype)
+        k_all = k_all.at[li, page_idx, kv_idx, off_idx].set(kc)
+        v_all = v_all.at[li, page_idx, kv_idx, off_idx].set(vc)
+        k_l = jax.lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)
+        v_l = jax.lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)
+        k_scale = v_scale = None
+        if ks_all is not None:
+            k_scale = virtual(jax.lax.dynamic_index_in_dim(
+                ks_all, li, 0, keepdims=False))
+            v_scale = virtual(jax.lax.dynamic_index_in_dim(
+                vs_all, li, 0, keepdims=False))
+        attn = _attend_cache(cfg, q, virtual(k_l), virtual(v_l), limits,
+                             st.prompt_lengths, st.prompt_slots,
+                             k_scale=k_scale, v_scale=v_scale)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, c, h * hd)
+        x = x + attn @ _w(layer["wo"], cfg.dtype)
+        return (_mlp(cfg, x, layer), (k_all, v_all, ks_all, vs_all)), None
+
+    n_layers = pool.k.shape[0]
+    (x, (k_new, v_new, ks_new, vs_new)), _ = jax.lax.scan(
+        block,
+        (x, (pool.k, pool.v, pool.k_scale, pool.v_scale)),
+        (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # (b, c)
+    emitted, st = _verify_accept(
+        greedy, drafts, st, eos_id=eos_id, pad_id=pad_id,
+    )
+    return emitted, st, PagedKVCache(
+        k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new,
+    )

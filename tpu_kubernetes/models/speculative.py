@@ -271,6 +271,29 @@ def prompt_lookup_generate(
     )
 
 
+def ngram_propose_host(ctx: list, n: int, k: int, last: int) -> list:
+    """:func:`_ngram_propose` as plain host python over a real-token
+    list — the proposer the continuous-batching slot engine runs per
+    slot between verify rounds (serve/server.py), standalone here so
+    its edge cases unit-test without a device. Find the LATEST earlier
+    occurrence of the last ``n`` tokens of ``ctx`` and return the k
+    tokens after it, padded with ``last`` when the historical
+    continuation runs out; no match (or a context too short to hold an
+    n-gram plus its recurrence) → ``last`` repeated k times.
+    Proposals only set the SPEED of the verify loop, never its tokens:
+    greedy verification keeps exactly the target's choices, so a bad
+    guess costs a round, not correctness."""
+    if n < 1 or k < 1:
+        raise ValueError(f"ngram ({n}) and draft_k ({k}) must be >= 1")
+    if len(ctx) > n:
+        tail = list(ctx[-n:])
+        for start in range(len(ctx) - n - 1, -1, -1):
+            if list(ctx[start:start + n]) == tail:
+                cont = list(ctx[start + n:start + n + k])
+                return cont + [last] * (k - len(cont))
+    return [last] * k
+
+
 def _ngram_propose(ctx: jax.Array, valid, n: int, k: int, last) -> jax.Array:
     """The prompt-lookup matcher, standalone for direct unit testing:
     find the LATEST occurrence of ``ctx[valid-n : valid]`` (the tail

@@ -47,6 +47,7 @@ SITES = frozenset({
     "serve.prefill",        # prefill/prefill_resume (solo + slot admission)
     "serve.slot_insert",    # _ContinuousEngine._insert (cache graft)
     "serve.segment",        # _ContinuousEngine._run_segment (decode step)
+    "serve.spec_verify",    # _ContinuousEngine speculative verify round
     "serve.shard_segment",  # _run_segment under SERVE_MESH (sharded program)
     "serve.prefix_insert",  # prefix KV-cache store insert (best-effort)
     "serve.page_alloc",     # PagePool.allocate (paged admission/top-up)

@@ -27,6 +27,12 @@ Classes:
 * ``bubble``     — decoded but never deliverable: slot-engine rows past
   their done mask, empty slots inside a segment, pad rows in a static
   batch, tokens beyond the requested budget, trailing EOS.
+* ``speculative-waste`` — verify-window cells whose draft the target
+  rejected: the slot engine decodes ``draft_k+1`` candidates per live
+  row per verify round and keeps only the accepted prefix (+1
+  correction); the rejected remainder is the price of speculation,
+  kept distinct from ``bubble`` so acceptance-rate regressions show up
+  in the ledger, not just the spec counters.
 
 Device seconds ride the same classes (``tpu_serve_device_seconds_total``)
 as best-effort attribution — tokens are the *tested* invariant.
@@ -56,7 +62,9 @@ CANCELLED = "cancelled"
 EXPIRED = "expired"
 SHED_SPENT = "shed-spent"
 BUBBLE = "bubble"
-CLASSES = (USEFUL, CANCELLED, EXPIRED, SHED_SPENT, BUBBLE)
+SPECULATIVE_WASTE = "speculative-waste"
+CLASSES = (USEFUL, CANCELLED, EXPIRED, SHED_SPENT, BUBBLE,
+           SPECULATIVE_WASTE)
 
 TIMELINE_MAX = 512
 

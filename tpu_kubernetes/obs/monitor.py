@@ -44,6 +44,8 @@ KV_FREE_PAGES = "tpu_serve_kv_pages"
 BUILD_INFO = "tpu_k8s_build_info"
 ROLE_INFO = "tpu_serve_role_info"
 SATURATION = "tpu_serve_saturation"
+SPEC_DRAFTED_M = "tpu_serve_spec_drafted_total"
+SPEC_ACCEPTED_M = "tpu_serve_spec_accepted_total"
 
 # how many slots each sparkline column renders (one char per slot)
 SPARK_BINS = 8
@@ -144,6 +146,14 @@ def fleet_rows(snapshot: FleetSnapshot,
             "queue_depth": snapshot.value_sum(INFLIGHT, mine),
             "goodput": round(useful / emitted, 4) if emitted else None,
         }
+        # speculative acceptance rate (accepted/drafted over both
+        # proposer sources) — None for workers that never drafted, so
+        # the column only lights up on speculating instances
+        drafted = snapshot.value_sum(SPEC_DRAFTED_M, mine)
+        accepted = snapshot.value_sum(SPEC_ACCEPTED_M, mine)
+        row["spec_accept"] = (
+            round(accepted / drafted, 4) if drafted else None
+        )
         if store is not None:
             row["rps"] = store.rate_over_time(
                 REQUESTS, window, snapshot.ts, mine
@@ -188,7 +198,7 @@ def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
         f"{'INSTANCE':<24} {'UP':>2} {'VER':>8} {'ROLE':>8} {'STATE':>9} "
         f"{'RPS':>8} "
         f"{'P50':>8} {'P99':>8} {'TTFT99':>8} {'TOK/S':>8} {'QUEUE':>6} "
-        f"{'SAT':>6} {'GOODPUT':>8}"
+        f"{'SAT':>6} {'GOODPUT':>8} {'SPEC%':>6}"
     )
     if with_trends:
         header += (
@@ -214,6 +224,7 @@ def render_table(rows: list[dict[str, Any]], alerts: list[Alert],
             f"{_fmt(int(row['queue_depth']), '', 7)}"
             f"{_fmt(row.get('saturation'), '', 7)}"
             f"{_fmt(row.get('goodput'), '', 9)}"
+            f"{_fmt(row.get('spec_accept'), '', 7)}"
         )
         if with_trends:
             spark = row.get("spark", {})

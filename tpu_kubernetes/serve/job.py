@@ -34,8 +34,11 @@ whole pipeline is env-driven like the trainer:
   SERVE_KV_QUANT       =1: int8 KV cache (half the cache bytes per
                        decode step; bounded attention rounding —
                        models/decode.KVCache). Composes with SERVE_QUANT;
-                       rejected in speculative/prompt-lookup modes
-                       (exact verification keeps a full-precision cache).
+                       rejected in the BATCH JOB's speculative/
+                       prompt-lookup modes (their solo verification
+                       loops keep a full-precision cache). The server's
+                       slot engine verifies exactly from int8 too, so
+                       there speculation composes with SERVE_KV_QUANT.
   SERVE_CACHE_SPAN     pin the KV-cache span (cache size changes XLA's
                        attention reduction order, so pinning it makes
                        runs bitwise-comparable across pipelines;
@@ -55,8 +58,17 @@ whole pipeline is env-driven like the trainer:
   SERVE_PROMPT_LOOKUP  =1: speculative decoding WITHOUT a draft model —
                        n-gram (SERVE_NGRAM, default 2) matches in the
                        seen context propose continuations
-                       (SERVE_DRAFT_K defaults to 8 here). Exclusive
-                       with SERVE_DRAFT_*; same greedy/batch-1 rules.
+                       (SERVE_DRAFT_K defaults to 8 here). When a
+                       SERVE_DRAFT_* model is also set, the draft model
+                       proposes (proposals never change tokens, so the
+                       precedence moves acceptance rate, not output);
+                       same greedy/batch-1 rules here. The HTTP
+                       server's slot engine lifts the batch-1 and
+                       single-device limits: with
+                       SERVE_CONTINUOUS_BATCHING=1 either proposer
+                       drives per-round (slots, draft_k+1) verify
+                       windows (docs/guide/serving.md "Speculative
+                       continuous batching").
   SERVE_DRAFT_KV_QUANT =1: int8 KV cache for the DRAFT model only —
                        drafts propose, never verify, so this can change
                        the acceptance rate but never the tokens (the
@@ -335,13 +347,14 @@ def run_serving(env: dict | None = None) -> list[str]:
             )
 
         if lookup and (draft_hf or draft_name):
-            raise SystemExit(
-                "SERVE_PROMPT_LOOKUP and SERVE_DRAFT_* are exclusive — "
-                "pick one drafting strategy"
-            )
-        # lookup + SERVE_DRAFT_KV_QUANT already failed the top-level
-        # needs-a-draft-model check (lookup has no draft model by the
-        # exclusivity rule above)
+            # both proposers configured: the draft model wins. Drafts
+            # only PROPOSE — the target verifies every token — so the
+            # choice moves the acceptance rate, never the output. Same
+            # precedence as the HTTP server's slot-engine proposer
+            # (serve/server.py spec_source).
+            log("draft: SERVE_PROMPT_LOOKUP and SERVE_DRAFT_* both set "
+                "— the draft model proposes (lookup ignored)")
+            lookup = False
         draft_kv = truthy_env(env, "SERVE_DRAFT_KV_QUANT")
         if lookup:
             from tpu_kubernetes.models import prompt_lookup_generate

@@ -185,15 +185,21 @@ PROMPT_TOKENS = REGISTRY.counter(
 )
 SPEC_ROUNDS = REGISTRY.counter(
     "tpu_serve_spec_rounds_total",
-    "prompt-lookup speculation: target passes (prefill included)",
+    "speculative decoding: target verify passes (solo lookup rounds, "
+    "prefill included, plus slot-engine verify rounds)",
 )
 SPEC_DRAFTED = REGISTRY.counter(
     "tpu_serve_spec_drafted_total",
-    "prompt-lookup speculation: tokens proposed",
+    "speculative decoding: tokens proposed, by proposer (ngram = "
+    "host-side prompt lookup, draft = the draft model)",
+    labelnames=("source",),
 )
 SPEC_ACCEPTED = REGISTRY.counter(
     "tpu_serve_spec_accepted_total",
-    "prompt-lookup speculation: proposed tokens the target kept",
+    "speculative decoding: proposed tokens the target kept, by "
+    "proposer (accepted/drafted is the acceptance rate the monitor's "
+    "SPEC% column shows)",
+    labelnames=("source",),
 )
 PROGRAM_CACHE = REGISTRY.counter(
     "tpu_serve_program_cache_total",
@@ -578,6 +584,19 @@ class _ContinuousEngine:
         self._rem = np.zeros(slots, np.int32)
         self._pl = np.zeros(slots, np.int32)
         self._ps = np.zeros(slots, np.int32)
+        # -- speculative decoding (spec_source != None) -----------------
+        # segments become VERIFY ROUNDS: each round runs the target once
+        # over a (slots, draft_k+1) window — every live row's current
+        # token plus its draft_k proposed continuations — accepts each
+        # row's matched prefix (+ the target's own correction token) and
+        # rolls the row's cache position back to what it accepted. The
+        # proposals are HOST state: one buffer per slot, refilled at
+        # admission and after every round from the proposer
+        # (ngram_propose_host, or the draft model's bucketed batch-1
+        # programs), so the compiled verify program has ONE static
+        # signature per draft_k.
+        self.spec_source = state.spec_source
+        self._proposals: list[list[int]] = [[] for _ in range(slots)]
         self.recycled = 0
         self.restarts = 0
         # per-segment timeline feed: admissions/reaps since the last
@@ -934,6 +953,8 @@ class _ContinuousEngine:
         self._rem[slot] = budget - 1     # the first token is emitted
         self._pl[slot] = len(ids)
         self._ps[slot] = width
+        if self.spec_source is not None:
+            self._refill_proposal(slot)
         self._last_admitted += 1
         if self.paged:
             # admission order feeds youngest-first preemption; the
@@ -1126,22 +1147,27 @@ class _ContinuousEngine:
                 )
                 self._pool = clr(self._pool, chunk_arr)
 
-    def _topup_pages(self) -> None:
+    def _topup_pages(self, adv_cap: int | None = None) -> None:
         """Pre-segment host allocation: grow every live row's table to
         cover the positions the next segment will write (compiled
-        programs never allocate — static shapes). Pool pressure
-        escalates in strict order: (1) drop the prefix store's pinned
-        pages, (2) preempt the YOUNGEST other resident row — greedy
-        decode is deterministic, so readmission re-emits its tokens
-        identically — (3) fail the row out (the pool cannot hold even
-        this one row). Each rung strictly shrinks demand, so the loop
-        terminates."""
+        programs never allocate — static shapes). ``adv_cap`` overrides
+        the per-row advance bound (the verify loop passes draft_k+1 —
+        one round's emittable extent; window writes past it fall
+        through the zero table entries into the page-0 sink, and the
+        garbage tokens those produce are clipped by the budget before
+        they can be emitted). Pool pressure escalates in strict order:
+        (1) drop the prefix store's pinned pages, (2) preempt the
+        YOUNGEST other resident row — greedy decode is deterministic,
+        so readmission re-emits its tokens identically — (3) fail the
+        row out (the pool cannot hold even this one row). Each rung
+        strictly shrinks demand, so the loop terminates."""
         import math
 
+        cap = self.seg_steps if adv_cap is None else adv_cap
         for i, entry in enumerate(self._entries):
             if entry is None:
                 continue
-            adv = min(self.seg_steps, int(self._rem[i]))
+            adv = min(cap, int(self._rem[i]))
             need = min(
                 math.ceil((int(self._pos[i]) + adv) / self.page_size),
                 self.max_pages,
@@ -1234,6 +1260,9 @@ class _ContinuousEngine:
 
         st = self._state
         if all(e is None for e in self._entries):
+            return
+        if self.spec_source is not None:
+            self._run_segment_spec()
             return
         FAULTS.fire("serve.segment")
         if st.mesh is not None:
@@ -1379,6 +1408,305 @@ class _ContinuousEngine:
         resident = sum(e is not None for e in self._entries)
         SLOT_OCCUPANCY.set(live / steps if resident else 0.0)
 
+    # -- speculative segments (spec_source != None) -------------------------
+
+    def _run_segment_spec(self) -> None:
+        """The speculative segment: up to ``seg_steps`` VERIFY ROUNDS,
+        then drain finished rows. One round = one fixed-shape
+        (slots, draft_k+1) target pass — each live row's window is its
+        current token plus its draft_k proposed continuations — with
+        ragged per-row acceptance: the row keeps its matched draft
+        prefix plus the target's own correction token, and its cache
+        position rolls back to exactly what it accepted. Dense, the
+        SlotState position rewind IS the rollback (rejected K/V is
+        garbage above the new position, overwritten by the next window
+        before anything can attend to it); paged, _truncate_pages
+        returns whole pages past the accepted position to the pool.
+        Emitted tokens are the target's own greedy choices at valid
+        context, so they are BITWISE the sequential engine's —
+        speculation moves throughput, never tokens."""
+        import functools
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_kubernetes.models.decode import (
+            SlotState,
+            decode_verify_paged,
+            decode_verify_slots,
+        )
+
+        st = self._state
+        FAULTS.fire("serve.segment")
+        if st.mesh is not None:
+            FAULTS.fire("serve.shard_segment")
+        k = st.draft_k
+        cells = k + 1
+        src = self.spec_source
+        occupied = sum(e is not None for e in self._entries)
+        rounds = 0
+        live_total = 0          # accepted tokens → _collected
+        cells_total = 0         # device row-cells produced (ledger)
+        elapsed_total = 0.0
+        for _ in range(self.seg_steps):
+            if not any(
+                e is not None and self._rem[i] > 0
+                for i, e in enumerate(self._entries)
+            ):
+                break
+            # the verify chaos site fires BEFORE the round's program: a
+            # fault here leaves every completed round fully settled, so
+            # ledger and page conservation hold mid-segment
+            FAULTS.fire("serve.spec_verify")
+            if self.paged:
+                # cover one round's emittable extent (k+1 per row);
+                # window writes past it fall through zero table entries
+                # into the page-0 sink — the garbage tokens those rows
+                # produce are budget-clipped before they can be
+                # emitted. May preempt/fail rows: re-check liveness
+                self._topup_pages(adv_cap=cells)
+                if all(e is None for e in self._entries):
+                    break
+            old_rem = self._rem.copy()
+            drafts_h = np.zeros((self.slots, k), np.int32)
+            for i, e in enumerate(self._entries):
+                if e is not None and old_rem[i] > 0:
+                    buf = self._proposals[i][:k]
+                    drafts_h[i, :len(buf)] = buf
+            state = SlotState(
+                tok=jnp.asarray(self._tok), pos=jnp.asarray(self._pos),
+                remaining=jnp.asarray(self._rem),
+                prompt_lengths=jnp.asarray(self._pl),
+                prompt_slots=jnp.asarray(self._ps),
+            )
+            drafts = jnp.asarray(drafts_h)
+            row_cells = self.slots * cells
+            t0 = time.perf_counter()
+            with st._lock:
+                if self.paged:
+                    key = ("paged_verify", k)
+                    args = (st.params, self._pool,
+                            jnp.asarray(self._table), state, drafts)
+                    ver = st._model_program(
+                        key, functools.partial(
+                            decode_verify_paged, cfg=st.cfg,
+                            eos_id=st.eos_id, pad_id=0,
+                        ), args, donate=(1,), ep=True,
+                    )
+                else:
+                    key = ("slot_verify", k)
+                    args = (st.params, self._cache, state, drafts)
+                    ver = st._model_program(
+                        key, functools.partial(
+                            decode_verify_slots, cfg=st.cfg,
+                            eos_id=st.eos_id, pad_id=0,
+                        ), args, donate=(1,), ep=True,
+                    )
+                PROFILER.record_cost(
+                    "decode", ver, args, tokens=row_cells, key=key,
+                )
+                with PROFILER.phase(
+                    "decode", key=key, tracer=TRACER,
+                ) as pd:
+                    if self.paged:
+                        toks, state, self._pool = pd.sync(ver(*args))
+                    else:
+                        toks, state, self._cache = pd.sync(ver(*args))
+            elapsed = time.perf_counter() - t0
+            elapsed_total += elapsed
+            rounds += 1
+            cells_total += row_cells
+            toks = np.asarray(toks)
+            new_pos = np.asarray(state.pos)
+            old_pos, self._pos = self._pos, new_pos.copy()
+            self._tok = np.asarray(state.tok).copy()
+            self._rem = np.asarray(state.remaining).copy()
+            emitted_round = 0
+            accepted_round = 0
+            live_rows = 0
+            for i, entry in enumerate(self._entries):
+                if entry is None:
+                    continue
+                n = int(new_pos[i] - old_pos[i])
+                out = toks[i][:n].tolist()
+                self._collected[i].extend(out)
+                emitted_round += n
+                entry["_device_s"] = (entry.get("_device_s") or 0.0) + \
+                    elapsed * n / row_cells
+                if old_rem[i] > 0:
+                    live_rows += 1
+                    # accepted = the emitted prefix that IS the draft
+                    # (the rest of the emission is the target's own
+                    # correction token, not speculation credit)
+                    for t in range(min(n, k)):
+                        if out[t] != int(drafts_h[i, t]):
+                            break
+                        accepted_round += 1
+            live_total += emitted_round
+            live_cells = live_rows * cells
+            if st.ready:
+                # per-round conservation: every device cell is produced
+                # here, and every cell that did NOT become a collected
+                # token settles NOW — rejected cells of live rows as
+                # speculative-waste, dead rows/empty slots as bubble.
+                # row_cells == emitted + waste + bubble by construction,
+                # and a fault before the NEXT round leaves nothing open
+                LEDGER.emitted(row_cells)
+                LEDGER.settle(
+                    "speculative-waste", live_cells - emitted_round,
+                    device_s=elapsed * (live_cells - emitted_round)
+                    / row_cells,
+                )
+                LEDGER.bubble(
+                    row_cells - live_cells,
+                    device_s=elapsed * (row_cells - live_cells)
+                    / row_cells,
+                )
+            SPEC_ROUNDS.inc(1)
+            SPEC_DRAFTED.labels(src).inc(k * live_rows)
+            SPEC_ACCEPTED.labels(src).inc(accepted_round)
+            with st._spec_lock:
+                st.spec_totals["rounds"] += 1
+                st.spec_totals["drafted"] += k * live_rows
+                st.spec_totals["accepted"] += accepted_round
+            if self.paged:
+                # rollback: whole pages past each row's accepted
+                # position go back to the pool, wiped cold
+                for i, e in enumerate(self._entries):
+                    if e is not None:
+                        self._truncate_pages(i)
+                self._update_page_gauge()
+            for i, e in enumerate(self._entries):
+                if e is not None and self._rem[i] > 0:
+                    self._refill_proposal(i)
+        # -- drain + per-segment bookkeeping (mirrors _run_segment) -----
+        seg_traces = sorted({
+            e["trace"] for e in self._entries
+            if e is not None and e.get("trace")
+        })
+        drained = 0
+        for i, entry in enumerate(self._entries):
+            if entry is not None and self._rem[i] <= 0:
+                entry["tokens"] = self._collected[i]
+                entry["event"].set()
+                self._retire(i)
+                drained += 1
+        if self._flightrec is not None:
+            self._flightrec.record_segment(
+                steps=rounds * cells, slots=self.slots,
+                occupied=occupied, live_steps=live_total,
+                admitted=self._last_admitted, drained=drained,
+                reaped=self._last_reaped,
+                seconds=round(elapsed_total, 6), queued=self.depth(),
+                pages=(dict(self._pages.stats()) if self.paged
+                       else None),
+                ledger={
+                    "emitted_delta": cells_total if st.ready else 0,
+                    "unsettled": LEDGER.unsettled(),
+                },
+                trace_ids=seg_traces,
+            )
+        if seg_traces:
+            TRACER.record(
+                "segment", elapsed_total, links=seg_traces,
+                steps=rounds * cells, live_steps=live_total,
+                drained=drained, device_s=round(elapsed_total, 6),
+                tokens_live=live_total,
+                tokens_bubble=cells_total - live_total,
+            )
+        if st.ready:
+            LEDGER.segment(
+                steps=rounds * cells, slots=self.slots,
+                occupied=occupied, live_steps=live_total,
+                admitted=self._last_admitted, drained=drained,
+                reaped=self._last_reaped, seconds=elapsed_total,
+            )
+            self._last_admitted = 0
+            self._last_reaped = 0
+        resident = sum(e is not None for e in self._entries)
+        SLOT_OCCUPANCY.set(
+            live_total / (rounds * cells) if resident and rounds
+            else 0.0
+        )
+
+    def _truncate_pages(self, slot: int) -> None:
+        """Roll a row's page table back to its accepted extent — the
+        pages the verify window wrote past the accepted position go
+        back to the pool (wiped, so their next tenant starts bitwise
+        cold; serve/pages.py refcounts keep shared prefix pages safe —
+        the kept prefix always covers them, pos never rolls below the
+        prompt). The kept tail page may hold rejected garbage above
+        the accepted position; the next window rewrites exactly those
+        positions before anything can attend to them."""
+        pos = int(self._pos[slot])
+        keep = (pos - 1) // self.page_size + 1 if pos > 0 else 0
+        pages = self._slot_pages[slot]
+        if len(pages) <= keep:
+            return
+        excess = pages[keep:]
+        self._slot_pages[slot] = pages[:keep]
+        self._table[slot, keep:] = 0
+        freed = self._pages.release(excess)
+        self._wipe_pages(freed)
+
+    def _refill_proposal(self, slot: int) -> None:
+        """(Re)fill the slot's host proposal buffer to draft_k tokens
+        from the configured proposer, over the row's full served
+        context (prompt + everything collected). Runs at admission and
+        after every verify round — partial acceptance shifts the
+        context, so stale proposals never survive a round."""
+        st = self._state
+        entry = self._entries[slot]
+        ctx = list(entry["ids"]) + self._collected[slot]
+        if self.spec_source == "draft":
+            self._proposals[slot] = self._draft_propose(ctx)
+        else:
+            from tpu_kubernetes.models.speculative import (
+                ngram_propose_host,
+            )
+
+            self._proposals[slot] = ngram_propose_host(
+                ctx, st.ngram, st.draft_k, int(self._tok[slot])
+            )
+
+    def _draft_propose(self, ctx: list) -> list:
+        """draft_k greedy tokens from the draft model over (a suffix
+        of) ``ctx`` — one cached batch-1 program per (width, draft_k)
+        signature, so the retrace sentinel sees a bounded program set.
+        The context truncates to the largest width bucket the draft's
+        max_seq can hold; proposals only move the acceptance rate, so
+        truncation carries no correctness weight."""
+        import functools
+
+        import numpy as np
+
+        from tpu_kubernetes.models import generate
+
+        st = self._state
+        k = st.draft_k
+        width = _bucket(len(ctx))
+        while width + k > st.draft_cfg.max_seq and width > 1:
+            width //= 2
+        ctx = ctx[-width:]
+        padded = np.zeros((1, width), np.int32)
+        padded[0, :len(ctx)] = ctx
+        lengths = np.asarray([len(ctx)], np.int32)
+        prog = st._cached_program(
+            ("spec_draft", width, k),
+            lambda: st._jax.jit(functools.partial(
+                generate, cfg=st.draft_cfg, max_new_tokens=k,
+                temperature=0.0, eos_id=None,
+                kv_quant=st.draft_kv_quant,
+            )),
+        )
+        jnp = st._jax.numpy
+        with st._lock:
+            out = prog(
+                st.draft_params, jnp.asarray(padded),
+                prompt_lengths=jnp.asarray(lengths),
+            )
+        return np.asarray(out)[0].tolist()
+
     def _clear_row(self, slot: int, best_effort: bool = False) -> None:
         """Reset slot ``slot`` back to bitwise-cold. Dense: the jitted
         cache_clear_row wipe. Paged: zero the table row (every read and
@@ -1419,6 +1747,7 @@ class _ContinuousEngine:
         self._clear_row(slot)
         self._entries[slot] = None
         self._collected[slot] = []
+        self._proposals[slot] = []
         self._pos[slot] = self._tok[slot] = self._rem[slot] = 0
         self._pl[slot] = self._ps[slot] = 0
 
@@ -1460,6 +1789,7 @@ class _ContinuousEngine:
         for i in range(self.slots):
             self._entries[i] = None
             self._collected[i] = []
+            self._proposals[i] = []
         for a in (self._pos, self._tok, self._rem, self._pl, self._ps):
             a[:] = 0
         st = self._state
@@ -1523,15 +1853,25 @@ class ServingState:
         self.encode, self.decode_text = encode, decode_text
         self.max_new_cap = env_int("SERVE_MAX_NEW", 64, env=env)
         self.kv_quant = truthy_env(env, "SERVE_KV_QUANT")
-        # SERVE_PROMPT_LOOKUP: draft-model-free speculation for solo
-        # GREEDY requests (models/speculative.py's n-gram idea, run as a
-        # host-driven loop so streaming works): jitted prefill at the
-        # bucketed width + a jitted (k+1)-token ragged chunk-verify
-        # program; proposals cost nothing and never change tokens —
-        # acceptance keeps exactly the target's greedy choices.
+        # SERVE_PROMPT_LOOKUP: draft-model-free speculation for GREEDY
+        # requests (models/speculative.py's n-gram idea, run host-side
+        # so streaming works): proposals cost nothing and never change
+        # tokens — acceptance keeps exactly the target's greedy
+        # choices. Solo it drives the batch-1 lookup loop
+        # (_lookup_rounds); with SERVE_CONTINUOUS_BATCHING=1 it drives
+        # the slot engine's per-round (slots, draft_k+1) verify step
+        # instead — speculation and slot throughput compose.
         self.prompt_lookup = truthy_env(env, "SERVE_PROMPT_LOOKUP")
         self.draft_k = env_int("SERVE_DRAFT_K", 8, env=env)
         self.ngram = env_int("SERVE_NGRAM", 2, env=env)
+        self.draft_kv_quant = truthy_env(env, "SERVE_DRAFT_KV_QUANT")
+        # the slot engine's proposer: None (no engine speculation),
+        # "ngram" (host prompt lookup) or "draft" (the draft model —
+        # wins when both are configured; drafts only propose, never
+        # verify, so the choice moves the acceptance rate, not tokens)
+        self.spec_source = None
+        self.draft_params = None
+        self.draft_cfg = None
         # cumulative speculation totals: written by batcher-dispatch /
         # handler threads (the _lookup_rounds finally), read by /healthz
         # handler threads — same lock discipline as the metrics registry
@@ -1587,6 +1927,10 @@ class ServingState:
         # stay single-device by design.
         self.mesh = None
         self._p_shardings = None
+        # read early: speculation routing below depends on whether the
+        # slot engine owns the greedy path
+        continuous = truthy_env(env, "SERVE_CONTINUOUS_BATCHING")
+        self._continuous = continuous
         mesh_spec = env.get("SERVE_MESH", "")
         if mesh_spec:
             from tpu_kubernetes.models import MoEConfig
@@ -1600,12 +1944,17 @@ class ServingState:
             )
             from tpu_kubernetes.topology import TopologyError, parse_mesh_shape
 
-            if truthy_env(env, "SERVE_PROMPT_LOOKUP"):
+            if self.prompt_lookup and not continuous:
                 # rejected BEFORE the mesh build + cross-chip device_put
-                # below — an always-doomed config must fail cheaply
+                # below — an always-doomed config must fail cheaply. The
+                # slot engine lifts this: its verify step runs through
+                # the sharded program builders (parallel/serving.py), so
+                # lookup × mesh composes under continuous batching.
                 raise ValueError(
-                    "SERVE_PROMPT_LOOKUP and SERVE_MESH are exclusive "
-                    "(the speculation loop is single-device)"
+                    "SERVE_PROMPT_LOOKUP and SERVE_MESH need "
+                    "SERVE_CONTINUOUS_BATCHING=1 (the solo speculation "
+                    "loop is single-device; the slot engine's verify "
+                    "step shards)"
                 )
             try:
                 shape = parse_mesh_shape(mesh_spec)
@@ -1663,42 +2012,102 @@ class ServingState:
         # (default 4 when unset/1 — slots are decode-batch rows, so the
         # same sizing intuition applies). Composes with SERVE_MESH —
         # the engine's caches and programs shard (parallel/serving.py)
-        # — and with MoE: the engine always decodes at the fixed slot
+        # — with MoE: the engine always decodes at the fixed slot
         # batch, so expert capacity is a constant shape no co-rider can
-        # change, and per-row tokens stay identical to solo greedy.
-        continuous = truthy_env(env, "SERVE_CONTINUOUS_BATCHING")
-        if continuous and self.prompt_lookup:
-            raise ValueError(
-                "SERVE_CONTINUOUS_BATCHING and SERVE_PROMPT_LOOKUP are "
-                "exclusive owners of the greedy path (speculation is "
-                "batch-1; the engine is a persistent batch) — pick one"
-            )
-        self._continuous = continuous
+        # change, and per-row tokens stay identical to solo greedy —
+        # and with speculation (SERVE_PROMPT_LOOKUP or a SERVE_DRAFT_*
+        # model): segments become fixed-shape (slots, draft_k+1)
+        # verify rounds against per-slot host proposal buffers.
 
-        if self.prompt_lookup:
-            # mirror the batch job's loud config rejections (serve/job.py)
-            # (lookup × SERVE_MESH already rejected above, pre-mesh-build)
+        # speculation config. A draft model (batch job parity:
+        # SERVE_DRAFT_MODEL preset or SERVE_DRAFT_HF_CHECKPOINT dir)
+        # only drives the slot engine's proposer here — the solo HTTP
+        # speculation path stays the draft-free lookup loop.
+        draft_hf = env.get("SERVE_DRAFT_HF_CHECKPOINT", "")
+        draft_name = env.get("SERVE_DRAFT_MODEL", "")
+        speculating = self.prompt_lookup or (
+            continuous and (draft_hf or draft_name)
+        )
+        if speculating:
             if isinstance(cfg, MoEConfig):
+                # MoE expert capacity is computed per forward chunk, so
+                # (k+1)-token verification can drop tokens sequential
+                # decode would keep — exactness would silently void
                 raise ValueError(
-                    "SERVE_PROMPT_LOOKUP needs a dense model (MoE chunk "
-                    "verification is not token-exact)"
-                )
-            if self.kv_quant:
-                raise ValueError(
-                    "SERVE_PROMPT_LOOKUP and SERVE_KV_QUANT are exclusive "
-                    "(exact verification uses a full-precision cache)"
+                    "speculative decoding needs a dense target model "
+                    "(MoE chunk verification is not token-exact)"
                 )
             if self.draft_k < 1 or self.ngram < 1:
                 raise ValueError(
                     f"SERVE_DRAFT_K ({self.draft_k}) and SERVE_NGRAM "
                     f"({self.ngram}) must be >= 1"
                 )
+        if self.prompt_lookup and not continuous:
+            # restrictions of the SOLO lookup loop only — the slot
+            # engine lifts both (its verify step decodes the whole slot
+            # batch, and int8 verification stays exact: rejected
+            # quantized writes are masked and overwritten, accepted
+            # ones are bitwise what sequential int8 decode writes)
+            if self.kv_quant:
+                raise ValueError(
+                    "SERVE_PROMPT_LOOKUP with SERVE_KV_QUANT needs "
+                    "SERVE_CONTINUOUS_BATCHING=1 (the solo verification "
+                    "cache is full-precision)"
+                )
             if batch > 1:
                 raise ValueError(
-                    "SERVE_PROMPT_LOOKUP and SERVER_BATCH are exclusive "
-                    "strategies (speculation is batch-1; batching "
-                    "amortizes throughput) — pick one"
+                    "SERVE_PROMPT_LOOKUP with SERVER_BATCH needs "
+                    "SERVE_CONTINUOUS_BATCHING=1 (the solo speculation "
+                    "loop is batch-1; the slot engine verifies the "
+                    "whole batch per round)"
                 )
+        if continuous and (draft_hf or draft_name):
+            # the engine's draft-model proposer: load once, propose per
+            # slot per round through bucketed batch-1 programs. Wins
+            # over lookup when both are set (proposals never change
+            # tokens, so precedence moves acceptance rate, not output).
+            from tpu_kubernetes.models import (
+                CONFIGS,
+                init_params,
+                load_hf,
+            )
+
+            if draft_hf:
+                self.draft_params, self.draft_cfg = load_hf(draft_hf)
+                log.info(f"server: draft model: HF checkpoint {draft_hf}")
+            else:
+                self.draft_cfg = CONFIGS[draft_name]
+                self.draft_params = init_params(
+                    jax.random.PRNGKey(1), self.draft_cfg
+                )
+                log.info(
+                    f"server: draft model: random-init {draft_name} "
+                    "(smoke mode)"
+                )
+            if isinstance(self.draft_cfg, MoEConfig):
+                raise ValueError(
+                    "the draft model must be dense (the proposer runs "
+                    "the plain decode path)"
+                )
+            if self.draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {self.draft_cfg.vocab_size} != target "
+                    f"vocab {cfg.vocab_size}"
+                )
+            if self.draft_cfg.max_seq < cfg.max_seq:
+                raise ValueError(
+                    f"draft max_seq {self.draft_cfg.max_seq} < target "
+                    f"max_seq {cfg.max_seq} — the proposer re-reads the "
+                    "full slot context"
+                )
+            self.spec_source = "draft"
+        elif continuous and self.prompt_lookup:
+            self.spec_source = "ngram"
+        elif self.draft_kv_quant and not (draft_hf or draft_name):
+            raise ValueError(
+                "SERVE_DRAFT_KV_QUANT needs a draft model "
+                "(SERVE_DRAFT_MODEL / SERVE_DRAFT_HF_CHECKPOINT)"
+            )
 
         if batch > 1 and isinstance(cfg, MoEConfig) and not continuous:
             # the ragged-row identity ROUND batching leans on is weaker
@@ -2107,10 +2516,14 @@ class ServingState:
             raise ValueError("max_new_tokens must be >= 1")
         ids = self.encode(prompt) or [0]      # empty prompt → one pad row
         width = _bucket(len(ids))
-        # lookup mode reserves draft_k cache slots for the transient
-        # chunk writes past the budget (models/speculative.py's span
-        # rule) — reserved uniformly so every request sees one limit
-        head = self.draft_k if self.prompt_lookup else 0
+        # speculation reserves draft_k cache slots for the transient
+        # chunk/window writes past the budget (models/speculative.py's
+        # span rule; the slot engine's verify window pokes up to
+        # draft_k positions past the last emittable one) — reserved
+        # uniformly so every request sees one limit
+        head = (self.draft_k
+                if (self.prompt_lookup or self.spec_source is not None)
+                else 0)
         if width + max_new + head > self.cfg.max_seq:
             raise ValueError(
                 f"prompt ({len(ids)} tokens, bucket {width}) + "
@@ -2607,8 +3020,8 @@ class ServingState:
                 self.spec_totals["drafted"] += drafted
                 self.spec_totals["accepted"] += accepted
             SPEC_ROUNDS.inc(rounds + 1)
-            SPEC_DRAFTED.inc(drafted)
-            SPEC_ACCEPTED.inc(accepted)
+            SPEC_DRAFTED.labels("ngram").inc(drafted)
+            SPEC_ACCEPTED.labels("ngram").inc(accepted)
             if self.ready:
                 TOKENS_GENERATED.inc(len(emitted))
                 PROMPT_TOKENS.inc(len(ids))
@@ -2670,9 +3083,11 @@ class ServingState:
         spec = None
         ledger_device_s = 0.0
         batch_span = None    # annotated with ledger token classes below
-        if self.prompt_lookup and greedy_default:
+        if self.prompt_lookup and greedy_default and self._engine is None:
             # draft-free speculation: tokens are exactly the greedy
-            # decode at this cache span, EOS-trimmed by the loop
+            # decode at this cache span, EOS-trimmed by the loop.
+            # (With the slot engine up, the engine owns the greedy path
+            # and speculation rides its verify rounds instead)
             finish: dict = {}
             with self._locked_phase():
                 with TRACER.phase("batch", quiet=True, mode="lookup"):
@@ -2830,10 +3245,14 @@ class ServingState:
         ids, max_new, run_max_new, width = self._validate(
             prompt, max_new_tokens
         )
-        if self.prompt_lookup and _is_greedy(temperature, top_k, top_p):
+        if (self.prompt_lookup and not self.kv_quant
+                and _is_greedy(temperature, top_k, top_p)):
             # speculation composes with streaming because the loop is
             # host-driven: whole ROUNDS of tokens surface at once (better
-            # than per-token pacing when proposals are accepted)
+            # than per-token pacing when proposals are accepted). The
+            # kv_quant guard: the solo lookup cache is full-precision,
+            # so under SERVE_KV_QUANT (engine-composed speculation only)
+            # streams take the plain int8 per-token loop instead
             yield from self._stream_lookup(
                 ids, width, run_max_new, max_new, finish
             )
@@ -3211,13 +3630,14 @@ class _Handler(BaseHTTPRequestHandler):
             "deadline_ms_default": st.deadline_ms,
             "max_queue": st.admission.max_queue,
         }
-        if st.prompt_lookup:
+        if st.prompt_lookup or st.spec_source is not None:
             with st._spec_lock:
                 t = dict(st.spec_totals)
             body["prompt_lookup"] = {
                 "draft_k": st.draft_k, "ngram": st.ngram,
                 "drafted": t["drafted"], "accepted": t["accepted"],
                 "rounds": t["rounds"],
+                "source": st.spec_source or "ngram",
             }
         return self._json(200, body)
 
